@@ -1,0 +1,90 @@
+"""paddle_tpu — a TPU-native deep learning framework with a Paddle-shaped API.
+
+Built from scratch on JAX/XLA/Pallas (see SURVEY.md for the blueprint mapping
+to the reference batizty/Paddle): dygraph eager execution over XLA's op cache,
+tape autograd powered by jax.vjp, whole-train-step compilation via
+paddle_tpu.jit.to_static, GSPMD/mesh-based hybrid parallelism under a
+Fleet-style API, and Pallas kernels for the attention hot path.
+"""
+
+from __future__ import annotations
+
+from . import framework
+from .framework import (  # noqa: F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    TPUPlace,
+    get_default_dtype,
+    get_device,
+    get_flags,
+    is_compiled_with_cuda,
+    seed,
+    set_default_dtype,
+    set_device,
+    set_flags,
+    get_rng_state,
+    set_rng_state,
+)
+from .tensor import Tensor, Parameter, to_tensor  # noqa: F401
+from .ops import *  # noqa: F401,F403
+from . import ops
+from . import autograd
+from .autograd import grad, no_grad, enable_grad, set_grad_enabled, is_grad_enabled  # noqa: F401
+# Bring-up note: submodule imports are appended as each subsystem lands.
+from . import nn  # noqa: E402
+from . import optimizer
+from . import amp
+from . import io
+from . import jit
+from . import vision
+from . import distributed
+from . import metric
+from . import device
+from . import profiler
+from . import incubate
+from .framework.io import save, load  # noqa: F401
+from .jit import to_static  # noqa: F401
+from .hapi import Model  # noqa: F401
+
+# dtype name constants (paddle.float32 is a dtype spec string here)
+float16 = "float16"
+bfloat16 = "bfloat16"
+float32 = "float32"
+float64 = "float64"
+int8 = "int8"
+int16 = "int16"
+int32 = "int32"
+int64 = "int64"
+uint8 = "uint8"
+bool = "bool"
+complex64 = "complex64"
+complex128 = "complex128"
+
+__version__ = "0.1.0"
+
+
+def disable_static(place=None):
+    """Dygraph is the default; kept for API compat."""
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is dygraph-first; use paddle_tpu.jit.to_static for compiled "
+        "execution (the static-graph path maps onto XLA step compilation)."
+    )
+
+
+def in_dynamic_mode():
+    return True
+
+
+def is_grad_enabled_():
+    return framework.core.grad_enabled()
+
+
+def summary(net, input_size=None, dtypes=None):
+    total = sum(p.size for p in net.parameters())
+    trainable = sum(p.size for p in net.parameters() if not p.stop_gradient)
+    print(f"Total params: {total}\nTrainable params: {trainable}")
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,205 @@
+"""paddle.autograd public surface (reference: python/paddle/autograd/)."""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+from ..framework import core as _core
+from ..tensor import Tensor
+from .engine import run_backward
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward."""
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is not None and isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    run_backward(
+        tensors,
+        grad_tensors,
+        inputs=None,
+        accumulate_into_leaves=True,
+        retain_graph=retain_graph,
+    )
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+    no_grad_vars=None,
+    name=None,
+):
+    """paddle.grad — compute grads of outputs w.r.t. inputs without touching .grad."""
+    single_out = isinstance(outputs, Tensor)
+    outputs = [outputs] if single_out else list(outputs)
+    single_in = isinstance(inputs, Tensor)
+    inputs = [inputs] if single_in else list(inputs)
+    if grad_outputs is not None and isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+    res = run_backward(
+        outputs,
+        grad_outputs,
+        inputs=inputs,
+        accumulate_into_leaves=False,
+        create_graph=create_graph,
+        retain_graph=retain_graph,
+    )
+    out = []
+    for t in inputs:
+        g = res.get(id(t))
+        if g is None and not allow_unused:
+            raise RuntimeError(
+                "One of the differentiated tensors appears to not have been used "
+                "in the graph. Set allow_unused=True if this is desired."
+            )
+        out.append(g)
+    return out
+
+
+class no_grad:
+    """Context manager AND decorator (paddle.no_grad)."""
+
+    def __enter__(self):
+        self._old = _core.set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        _core.set_grad_enabled(self._old)
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._old = _core.set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        _core.set_grad_enabled(self._old)
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with enable_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+@contextlib.contextmanager
+def set_grad_enabled(mode: bool):
+    old = _core.set_grad_enabled(mode)
+    try:
+        yield
+    finally:
+        _core.set_grad_enabled(old)
+
+
+def is_grad_enabled():
+    return _core.grad_enabled()
+
+
+# ---------------------------------------------------------------------------
+# PyLayer — custom autograd op (reference: python/paddle/autograd/py_layer.py)
+# ---------------------------------------------------------------------------
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self._materialize_grads = True
+        self.__dict__["_attrs"] = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_not_inplace(self, *a):
+        pass
+
+    def set_materialize_grads(self, v):
+        self._materialize_grads = bool(v)
+
+
+class PyLayerMeta(type):
+    def __call__(cls, *a, **k):
+        raise RuntimeError("PyLayer subclasses are used via .apply(...)")
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..autograd.engine import GradNode
+        from ..ops.dispatch import wrap
+
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = _core.grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs
+        )
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        single = isinstance(out, Tensor)
+        outputs = [out] if single else list(out)
+        if not needs_grad:
+            return out
+
+        out_tensors = []
+        for o in outputs:
+            t = o.detach()
+            t.stop_gradient = False
+            out_tensors.append(t)
+
+        def vjp_fn(cotangents):
+            cts = [wrap(c) for c in cotangents]
+            with no_grad():
+                gin = cls.backward(ctx, *(cts if len(cts) > 1 else cts))
+            if isinstance(gin, Tensor):
+                gin = (gin,)
+            return tuple(
+                g._data if isinstance(g, Tensor) else g for g in gin
+            )
+
+        node = GradNode(cls.__name__, None, vjp_fn, tensor_inputs, out_tensors)
+        # PyLayer graphs can be re-run (backward clears consumed only on release)
+        for j, t in enumerate(out_tensors):
+            t._grad_node = node
+            t._out_index = j
+        return out_tensors[0] if single else tuple(out_tensors)
+
+
+# paddle.autograd.saved_tensors_hooks — minimal compat
+@contextlib.contextmanager
+def saved_tensors_hooks(pack_hook, unpack_hook):
+    yield

@@ -1,0 +1,313 @@
+"""Eager autograd tape + backward engine.
+
+TPU-native re-design of the reference's eager autograd
+(paddle/fluid/eager/backward.cc `egr::Backward`, GradNodeBase,
+GradTensorHolder — SURVEY.md §2.1 "Eager autograd"): instead of ~200k lines of
+codegen'd per-op GradNodes, every op records ONE generic node whose vjp
+closure comes from `jax.vjp` at call time.  The closure works on concrete
+arrays (eager) and on tracers (inside @to_static), so a *single* autograd
+implementation serves both the dygraph path and whole-step XLA compilation.
+
+Double grad (create_graph=True) re-derives each node's VJP *through the
+dispatcher* as a differentiable function of (primal inputs, cotangents), so
+the backward computation itself lands on the tape.
+"""
+
+from __future__ import annotations
+
+import weakref
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import core as _core
+
+
+def _zeros_for(aval):
+    shape, dtype = aval
+    if jnp.issubdtype(dtype, jnp.inexact):
+        return jnp.zeros(shape, dtype)
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+class Edge:
+    """Autograd edge captured at record time.
+
+    In-place ops rebind a Tensor's payload/identity (dispatch.inplace_rebind),
+    so nodes must NOT chase `t._grad_node` at backward time — they follow the
+    producer (node, index) frozen when the consuming op recorded.  `tensor`
+    stays for leaf accumulation, hooks, and double-grad connectivity.
+    """
+
+    __slots__ = ("node", "index", "tensor")
+
+    def __init__(self, tensor):
+        self.node = tensor._grad_node
+        self.index = tensor._out_index
+        self.tensor = tensor
+
+
+class GradNode:
+    """One recorded op on the tape."""
+
+    __slots__ = (
+        "name",
+        "primal_fn",
+        "vjp_fn",
+        "input_edges",
+        "out_avals",
+        "out_refs",
+        "consumed",
+        "__weakref__",
+    )
+
+    def __init__(self, name, primal_fn, vjp_fn, input_tensors, outputs):
+        self.name = name
+        self.primal_fn = primal_fn
+        self.vjp_fn = vjp_fn
+        self.input_edges = [Edge(t) for t in input_tensors]
+        self.out_avals = [(tuple(o._raw.shape), jnp.dtype(o._raw.dtype)) for o in outputs]
+        self.out_refs = [weakref.ref(o) for o in outputs]
+        self.consumed = False
+
+    @property
+    def n_out(self):
+        return len(self.out_avals)
+
+    @property
+    def input_tensors(self):
+        return [e.tensor for e in self.input_edges]
+
+    def parents(self):
+        seen = []
+        for e in self.input_edges:
+            if e.node is not None and e.node not in seen:
+                seen.append(e.node)
+        return seen
+
+    def release(self):
+        self.vjp_fn = None
+        self.primal_fn = None
+        self.consumed = True
+
+    # -- apply ----------------------------------------------------------
+    def apply_fast(self, cotangents):
+        """cotangents: list (len n_out) of raw arrays or None → raw input cts."""
+        if self.consumed or self.vjp_fn is None:
+            raise RuntimeError(
+                f"Trying to run backward through op '{self.name}' a second time. "
+                "Set retain_graph=True if you need to backward multiple times."
+            )
+        cts = tuple(
+            c if c is not None else _zeros_for(av)
+            for c, av in zip(cotangents, self.out_avals)
+        )
+        return self.vjp_fn(cts)
+
+    def apply_create_graph(self, cotangents):
+        """Record the VJP as tape ops; cotangents are Tensors or None."""
+        from ..ops.dispatch import apply as _apply
+        from ..tensor import Tensor
+
+        if self.primal_fn is None:
+            raise RuntimeError(
+                f"Graph for op '{self.name}' was already released; "
+                "use retain_graph=True for double backward."
+            )
+        n_in = len(self.input_tensors)
+        live_ct = [(i, c) for i, c in enumerate(cotangents) if c is not None]
+        live_idx = [i for i, _ in live_ct]
+        avals = self.out_avals
+        primal_fn = self.primal_fn
+
+        def bwd(*flat):
+            xs = flat[:n_in]
+            cts_in = flat[n_in:]
+            _, vjp = jax.vjp(primal_fn, *xs)
+            full = []
+            k = 0
+            for j, av in enumerate(avals):
+                if j in live_idx:
+                    full.append(cts_in[k])
+                    k += 1
+                else:
+                    full.append(_zeros_for(av))
+            return vjp(tuple(full))
+
+        ct_tensors = []
+        for _, c in live_ct:
+            if not isinstance(c, Tensor):
+                t = Tensor.__new__(Tensor)
+                t._init_from_array(c, stop_gradient=True)
+                c = t
+            ct_tensors.append(c)
+        outs = _apply(bwd, list(self.input_tensors) + ct_tensors,
+                      name=f"{self.name}_grad", multi=True)
+        return outs  # tuple of Tensors, one per input
+
+
+def _acc(a, b):
+    """Accumulate cotangents; handles None / raw arrays / Tensors."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    from ..tensor import Tensor
+
+    if isinstance(a, Tensor) or isinstance(b, Tensor):
+        from .. import ops
+
+        if not isinstance(a, Tensor):
+            t = Tensor.__new__(Tensor)
+            a = t._init_from_array(a, stop_gradient=True)
+        if not isinstance(b, Tensor):
+            t = Tensor.__new__(Tensor)
+            b = t._init_from_array(b, stop_gradient=True)
+        return ops.add(a, b)
+    if isinstance(a, np.ndarray) and a.dtype == jax.dtypes.float0:
+        return a
+    return a + b
+
+
+def _raw(x):
+    from ..tensor import Tensor
+
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _topo_order(roots):
+    """Topological order of reachable nodes (parents before children)."""
+    order = []
+    state = {}  # node -> 0 visiting, 1 done
+
+    for root in roots:
+        if root in state:
+            continue
+        stack = [(root, iter(root.parents()))]
+        state[root] = 0
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for p in it:
+                if p not in state:
+                    state[p] = 0
+                    stack.append((p, iter(p.parents())))
+                    advanced = True
+                    break
+            if not advanced:
+                state[node] = 1
+                order.append(node)
+                stack.pop()
+    return order
+
+
+def run_backward(
+    outputs,
+    out_grads=None,
+    inputs=None,
+    accumulate_into_leaves=True,
+    create_graph=False,
+    retain_graph=False,
+):
+    """Shared engine for Tensor.backward and paddle.grad.
+
+    Returns dict id(tensor) -> cotangent for requested `inputs` (if given).
+    """
+    from ..tensor import Tensor
+
+    retain_graph = retain_graph or create_graph
+
+    if out_grads is None:
+        out_grads = [None] * len(outputs)
+
+    requested = {id(t): None for t in (inputs or [])}
+    requested_tensors = {id(t): t for t in (inputs or [])}
+
+    node_cts = {}
+    roots = []
+    leaf_results = []  # (tensor, grad) pairs resolved pre-topo (direct leaves)
+
+    for t, g in zip(outputs, out_grads):
+        if g is None:
+            if not jnp.issubdtype(jnp.dtype(t._raw.dtype), jnp.inexact):
+                raise RuntimeError("backward() on non-float tensor requires grad_tensor")
+            g = jnp.ones(t._raw.shape, t._raw.dtype)
+        else:
+            g = _raw(g) if not create_graph else (g if isinstance(g, Tensor) else g)
+        node = t._grad_node
+        if node is None:
+            if not t.stop_gradient:
+                leaf_results.append((t, g))
+            continue
+        slots = node_cts.setdefault(node, [None] * node.n_out)
+        slots[t._out_index] = _acc(slots[t._out_index], g)
+        roots.append(node)
+
+    order = _topo_order(roots)
+
+    def _apply_hooks(t, g):
+        if t is not None and t._hooks:
+            for h in t._hooks:
+                r = h(_wrap(g))
+                if r is not None:
+                    g = r._data if isinstance(r, Tensor) else r
+        return g
+
+    def _wrap(g):
+        if isinstance(g, Tensor):
+            return g
+        t = Tensor.__new__(Tensor)
+        return t._init_from_array(g, stop_gradient=not create_graph)
+
+    def _route_leaf(t, g):
+        g = _apply_hooks(t, g)
+        if id(t) in requested:
+            requested[id(t)] = _acc(requested[id(t)], g)
+        if accumulate_into_leaves and not t.stop_gradient:
+            newg = _acc(t.grad, g)
+            t.grad = newg
+
+    for t, g in leaf_results:
+        _route_leaf(t, g)
+
+    for node in reversed(order):
+        cts = node_cts.pop(node, None)
+        if cts is None:
+            continue
+        # output hooks + requested intermediates
+        for j, ref in enumerate(node.out_refs):
+            ot = ref()
+            if ot is None:
+                continue
+            if cts[j] is not None:
+                cts[j] = _apply_hooks(ot, cts[j])
+                if id(ot) in requested:
+                    requested[id(ot)] = _acc(requested[id(ot)], cts[j])
+        if create_graph:
+            in_cts = node.apply_create_graph([c if c is None else _wrap(c) for c in cts])
+        else:
+            in_cts = node.apply_fast([_raw(c) if c is not None else None for c in cts])
+        for e, g in zip(node.input_edges, in_cts):
+            if g is None:
+                continue
+            if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
+                continue
+            if e.node is not None:
+                slots = node_cts.setdefault(e.node, [None] * e.node.n_out)
+                slots[e.index] = _acc(slots[e.index], g)
+            else:
+                _route_leaf(e.tensor, g)
+        if not retain_graph:
+            node.release()
+
+    out = {}
+    for tid, g in requested.items():
+        t = requested_tensors[tid]
+        if g is None:
+            out[tid] = None
+        else:
+            out[tid] = _wrap(g) if not isinstance(g, Tensor) else g
+            if not create_graph:
+                out[tid].stop_gradient = True
+    return out

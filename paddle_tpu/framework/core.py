@@ -1,0 +1,349 @@
+"""Core framework state: dtypes, places, devices, global modes.
+
+TPU-native re-design of the reference's platform layer
+(paddle/phi/common/place.h, paddle/phi/core/flags.cc — see SURVEY.md §2.1
+"Device/platform" / "Flags/config").  Instead of a DeviceContext pool over
+CUDA streams, devices are JAX/PJRT devices; `set_device` selects the default
+placement for newly created tensors.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------------------
+# dtypes
+# ---------------------------------------------------------------------------
+
+_STR2DTYPE = {
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "uint8": jnp.uint8,
+    "uint16": jnp.uint16,
+    "uint32": jnp.uint32,
+    "bool": jnp.bool_,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+_ALIASES = {
+    "fp16": "float16",
+    "bf16": "bfloat16",
+    "fp32": "float32",
+    "fp64": "float64",
+    "half": "float16",
+    "float": "float32",
+    "double": "float64",
+    "int": "int32",
+    "long": "int64",
+}
+
+
+def convert_dtype(dtype):
+    """Normalize a dtype spec (string / numpy / jnp dtype) to a canonical string."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name not in _STR2DTYPE:
+            raise ValueError(f"Unsupported dtype string: {dtype!r}")
+        return name
+    try:
+        name = np.dtype(dtype).name
+    except TypeError:
+        name = getattr(dtype, "name", None) or str(dtype)
+    name = {"bool_": "bool"}.get(name, name)
+    if name not in _STR2DTYPE:
+        raise ValueError(f"Unsupported dtype: {dtype!r}")
+    return name
+
+
+def to_jax_dtype(dtype):
+    if dtype is None:
+        return None
+    if isinstance(dtype, str) or isinstance(dtype, type):
+        return _STR2DTYPE[convert_dtype(dtype)]
+    return jnp.dtype(dtype) if not hasattr(dtype, "dtype") else dtype
+
+
+def is_floating_dtype(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(to_jax_dtype(convert_dtype(dtype))), jnp.inexact)
+
+
+_default_dtype = "float32"
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in ("float16", "bfloat16", "float32", "float64"):
+        raise TypeError(f"set_default_dtype only supports float dtypes, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype() -> str:
+    return _default_dtype
+
+
+# ---------------------------------------------------------------------------
+# Places / devices
+# ---------------------------------------------------------------------------
+
+
+class Place:
+    """Device placement, mirroring the reference's phi::Place taxonomy.
+
+    On this framework a place maps onto a JAX device: ``TPUPlace(i)`` is the
+    i-th accelerator chip (PJRT device), ``CPUPlace()`` the host platform.
+    """
+
+    device_type = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self._device_id = int(device_id)
+
+    def get_device_id(self) -> int:
+        return self._device_id
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self._device_id == other._device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self._device_id))
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self._device_id})"
+
+    # -- JAX bridge ------------------------------------------------------
+    def jax_device(self):
+        devs = _devices_for(self.device_type)
+        if not devs:
+            raise RuntimeError(f"No {self.device_type} devices available")
+        return devs[self._device_id % len(devs)]
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class TPUPlace(Place):
+    device_type = "tpu"
+
+    def __repr__(self):
+        return f"TPUPlace({self._device_id})"
+
+
+class CUDAPlace(Place):  # accepted for API compat; maps to accelerator if any
+    device_type = "gpu"
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+def _devices_for(kind: str):
+    try:
+        if kind == "cpu":
+            return jax.devices("cpu")
+        # any non-cpu accelerator backend counts as "tpu"/"gpu"
+        default = jax.devices()
+        if default and default[0].platform != "cpu":
+            return default
+        return []
+    except RuntimeError:
+        return []
+
+
+_current_place = None
+_place_lock = threading.Lock()
+
+
+def _default_place() -> Place:
+    devs = jax.devices()
+    if devs[0].platform == "cpu":
+        return CPUPlace(0)
+    return TPUPlace(0)
+
+
+def get_device() -> str:
+    p = _expected_place()
+    if isinstance(p, CPUPlace):
+        return "cpu"
+    return f"{p.device_type}:{p.get_device_id()}"
+
+
+def _expected_place() -> Place:
+    global _current_place
+    if _current_place is None:
+        with _place_lock:
+            if _current_place is None:
+                _current_place = _default_place()
+    return _current_place
+
+
+def set_device(device) -> Place:
+    """paddle.set_device: 'cpu', 'tpu', 'tpu:0', 'gpu:0' (alias of tpu here)."""
+    global _current_place
+    if isinstance(device, Place):
+        _current_place = device
+        return device
+    dev = str(device).lower()
+    if ":" in dev:
+        kind, _, idx = dev.partition(":")
+        idx = int(idx)
+    else:
+        kind, idx = dev, 0
+    if kind == "cpu":
+        _current_place = CPUPlace(idx)
+    elif kind in ("tpu", "xpu"):
+        _current_place = TPUPlace(idx)
+    elif kind in ("gpu", "cuda"):
+        # reference scripts say gpu; route to the accelerator
+        _current_place = TPUPlace(idx)
+    else:
+        raise ValueError(f"Unknown device {device!r}")
+    return _current_place
+
+
+def device_count(kind: str = "tpu") -> int:
+    return len(_devices_for(kind))
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return bool(_devices_for("tpu"))
+
+
+# ---------------------------------------------------------------------------
+# Global execution modes (grad, trace) — thread-local
+# ---------------------------------------------------------------------------
+
+
+class _ModeState(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        self.trace = None  # active jit trace (paddle_tpu.jit), or None
+        self.amp = None  # active amp state (paddle_tpu.amp), or None
+
+
+_mode = _ModeState()
+
+
+def grad_enabled() -> bool:
+    return _mode.grad_enabled
+
+
+def set_grad_enabled(flag: bool) -> bool:
+    old = _mode.grad_enabled
+    _mode.grad_enabled = bool(flag)
+    return old
+
+
+@contextlib.contextmanager
+def no_grad_ctx():
+    old = set_grad_enabled(False)
+    try:
+        yield
+    finally:
+        set_grad_enabled(old)
+
+
+@contextlib.contextmanager
+def enable_grad_ctx():
+    old = set_grad_enabled(True)
+    try:
+        yield
+    finally:
+        set_grad_enabled(old)
+
+
+def active_trace():
+    return _mode.trace
+
+
+def set_active_trace(tr):
+    old = _mode.trace
+    _mode.trace = tr
+    return old
+
+
+def active_amp():
+    return _mode.amp
+
+
+def set_active_amp(state):
+    old = _mode.amp
+    _mode.amp = state
+    return old
+
+
+# ---------------------------------------------------------------------------
+# Flags registry (reference: PHI_DEFINE_EXPORTED_* gflags, paddle.set_flags)
+# ---------------------------------------------------------------------------
+
+_FLAG_DEFS = {}  # name -> (type, default, help)
+_flags = {}
+
+
+def define_flag(name: str, default, help: str = ""):
+    _FLAG_DEFS[name] = (type(default), default, help)
+    env = os.environ.get(name)
+    if env is not None:
+        _flags[name] = _parse_flag(type(default), env)
+    else:
+        _flags[name] = default
+
+
+def _parse_flag(typ, text):
+    if typ is bool:
+        return text.lower() in ("1", "true", "yes", "on")
+    return typ(text)
+
+
+def get_flags(names):
+    if isinstance(names, str):
+        names = [names]
+    return {n: _flags[n] for n in names}
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        if k not in _FLAG_DEFS:
+            raise KeyError(f"Unknown flag {k!r}")
+        typ = _FLAG_DEFS[k][0]
+        _flags[k] = _parse_flag(typ, v) if isinstance(v, str) and typ is not str else typ(v)
+
+
+def flag(name):
+    return _flags[name]
+
+
+# core flags mirroring the reference's most used ones
+define_flag("FLAGS_check_nan_inf", False, "scan op outputs for nan/inf")
+define_flag("FLAGS_cudnn_deterministic", False, "deterministic ops (no-op on XLA)")
+define_flag("FLAGS_use_stride_kernel", False, "compat only")
+define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92, "compat only; XLA preallocation")
+define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "compat only; GC by refcount")
+define_flag("FLAGS_log_level", 0, "VLOG level for python-side logging")

@@ -1,0 +1,59 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py).
+
+Serialization: state dicts of Tensors → pickled dict of numpy arrays.  The
+.pdparams/.pdopt naming conventions of the reference are honored.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+
+def _to_serializable(obj):
+    from ..tensor import Tensor
+
+    if isinstance(obj, Tensor):
+        arr = obj.numpy()
+        if arr.dtype.name == "bfloat16":
+            import jax.numpy as jnp
+            return {"__bf16__": np.asarray(arr, dtype=np.float32)}
+        return arr
+    if isinstance(obj, dict):
+        return {k: _to_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_serializable(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def _from_serializable(obj):
+    if isinstance(obj, dict):
+        if set(obj.keys()) == {"__bf16__"}:
+            import jax.numpy as jnp
+            from ..tensor import Tensor
+            return Tensor(jnp.asarray(obj["__bf16__"], dtype=jnp.bfloat16))
+        return {k: _from_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, np.ndarray):
+        from ..tensor import Tensor
+        return Tensor(obj)
+    if isinstance(obj, (list, tuple)):
+        t = [_from_serializable(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_serializable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        data = pickle.load(f)
+    return _from_serializable(data)

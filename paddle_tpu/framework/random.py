@@ -1,0 +1,73 @@
+"""Seeded RNG state (reference: paddle/phi/core/generator.cc, paddle.seed).
+
+TPU-native design: the generator owns a JAX PRNG key held in a Tensor so the
+jit step-compiler's state-capture treats randomness as threaded state — each
+compiled step consumes and advances the key functionally (no baked-in
+constants), while eager mode simply splits the key per call.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._seed = int(seed)
+        self._key_tensor = None  # lazily created Tensor holding the PRNG key
+
+    def _ensure(self):
+        if self._key_tensor is None:
+            from ..tensor import Tensor
+
+            self._key_tensor = Tensor(
+                jax.random.key_data(jax.random.PRNGKey(self._seed)),
+                stop_gradient=True,
+            )
+        return self._key_tensor
+
+    def manual_seed(self, seed: int):
+        from ..tensor import Tensor
+
+        self._seed = int(seed)
+        self._key_tensor = Tensor(
+            jax.random.key_data(jax.random.PRNGKey(self._seed)), stop_gradient=True
+        )
+        return self
+
+    @property
+    def initial_seed(self):
+        return self._seed
+
+    def next_key(self):
+        """Split the state key; returns a fresh PRNG key (wrapped, typed)."""
+        holder = self._ensure()
+        raw = holder._data  # trace-aware read
+        key = jax.random.wrap_key_data(raw)
+        new_key, sub = jax.random.split(key)
+        holder._data = jax.random.key_data(new_key)  # trace-aware write
+        return sub
+
+    def get_state(self):
+        return self._ensure()._data
+
+    def set_state(self, state):
+        self._ensure()._data = jnp.asarray(state)
+
+
+default_generator = Generator(0)
+
+
+def seed(value: int):
+    """paddle.seed — reset the global generator."""
+    default_generator.manual_seed(int(value))
+    return default_generator
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state):
+    default_generator.set_state(state)

@@ -1,0 +1,3 @@
+# placeholder during bring-up
+class Model:
+    pass

@@ -1,0 +1,3 @@
+# placeholder during bring-up
+def to_static(fn=None, **kw):
+    raise NotImplementedError('to_static lands in M3')

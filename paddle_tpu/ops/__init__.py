@@ -1,0 +1,210 @@
+"""Op namespace + Tensor method patching.
+
+Mirrors the reference's pattern of monkey-patching generated op functions
+onto the eager Tensor (paddle/fluid/pybind/eager_op_function* +
+python/paddle/tensor/__init__.py tensor_method_func list — SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from .dispatch import apply, coerce, wrap, inplace_rebind
+
+from .math import *  # noqa: F401,F403
+from .creation import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+
+from . import math as _math
+from . import creation as _creation
+from . import manipulation as _manipulation
+from . import reduction as _reduction
+from . import search as _search
+from . import random as _random
+from . import linalg as _linalg
+
+
+# ---------------------------------------------------------------------------
+# indexing
+# ---------------------------------------------------------------------------
+
+
+def _prep_index(key):
+    """Normalize a python index; returns (static_key_builder, tensor_indices)."""
+    if not isinstance(key, tuple):
+        key = (key,)
+    tensors = []
+    spec = []
+    for k in key:
+        if isinstance(k, Tensor):
+            spec.append(("t", len(tensors), k.dtype == "bool"))
+            tensors.append(k)
+        elif isinstance(k, np.ndarray):
+            spec.append(("a", jnp.asarray(k), k.dtype == np.bool_))
+        elif isinstance(k, (list,)):
+            arr = np.asarray(k)
+            spec.append(("a", jnp.asarray(arr), arr.dtype == np.bool_))
+        else:
+            spec.append(("s", k, False))
+    return spec, tensors
+
+
+def _build_key(spec, arrays):
+    out = []
+    for kind, v, is_bool in spec:
+        if kind == "t":
+            a = arrays[v]
+            if jnp.issubdtype(a.dtype, jnp.integer):
+                a = a.astype(jnp.int32)
+            out.append(a)
+        elif kind == "a":
+            out.append(v)
+        else:
+            out.append(v)
+    return tuple(out)
+
+
+def _getitem(self, key):
+    spec, tensors = _prep_index(key)
+    has_bool = builtins.any(b for _, _, b in spec)
+    if has_bool:
+        # boolean masking → dynamic shape: eager numpy path
+        arr = np.asarray(self._data)
+        np_key = tuple(
+            np.asarray(tensors[v]._data) if kind == "t" else (np.asarray(v) if kind == "a" else v)
+            for kind, v, _ in spec
+        )
+        return wrap(jnp.asarray(arr[np_key if len(np_key) > 1 else np_key[0]]))
+
+    def f(a, *idx_arrays):
+        k = _build_key(spec, idx_arrays)
+        return a[k if len(k) > 1 else k[0]]
+
+    return apply(f, [self] + tensors, name="getitem")
+
+
+def _setitem(self, key, value):
+    spec, tensors = _prep_index(key)
+    is_value_tensor = isinstance(value, (Tensor, np.ndarray, list)) or (
+        not isinstance(value, (int, float, bool))
+    )
+    inputs = [self]
+    if is_value_tensor:
+        value = coerce(value)
+        inputs.append(value)
+    inputs += tensors
+
+    def f(a, *rest):
+        if is_value_tensor:
+            v, idx_arrays = rest[0], rest[1:]
+        else:
+            v, idx_arrays = value, rest
+        k = _build_key(spec, idx_arrays)
+        k = k if len(k) > 1 else k[0]
+        if hasattr(v, "astype") and hasattr(v, "dtype") and v.dtype != a.dtype:
+            v = v.astype(a.dtype)
+        return a.at[k].set(v)
+
+    out = apply(f, inputs, name="setitem")
+    return inplace_rebind(self, out)
+
+
+Tensor.__getitem__ = _getitem
+Tensor.__setitem__ = _setitem
+
+
+# ---------------------------------------------------------------------------
+# operator protocol
+# ---------------------------------------------------------------------------
+
+Tensor.__add__ = lambda s, o: _math.add(s, o)
+Tensor.__radd__ = lambda s, o: _math.add(o, s)
+Tensor.__sub__ = lambda s, o: _math.subtract(s, o)
+Tensor.__rsub__ = lambda s, o: _math.subtract(o, s)
+Tensor.__mul__ = lambda s, o: _math.multiply(s, o)
+Tensor.__rmul__ = lambda s, o: _math.multiply(o, s)
+Tensor.__truediv__ = lambda s, o: _math.divide(s, o)
+Tensor.__rtruediv__ = lambda s, o: _math.divide(o, s)
+Tensor.__floordiv__ = lambda s, o: _math.floor_divide(s, o)
+Tensor.__rfloordiv__ = lambda s, o: _math.floor_divide(o, s)
+Tensor.__mod__ = lambda s, o: _math.remainder(s, o)
+Tensor.__rmod__ = lambda s, o: _math.remainder(o, s)
+Tensor.__pow__ = lambda s, o: _math.pow(s, o)
+Tensor.__rpow__ = lambda s, o: _math.pow(o, s)
+Tensor.__matmul__ = lambda s, o: _math.matmul(s, o)
+Tensor.__rmatmul__ = lambda s, o: _math.matmul(o, s)
+Tensor.__neg__ = lambda s: _math.neg(s)
+Tensor.__abs__ = lambda s: _math.abs(s)
+Tensor.__invert__ = lambda s: _math.logical_not(s) if s.dtype == "bool" else _math.bitwise_not(s)
+Tensor.__and__ = lambda s, o: _math.logical_and(s, o) if s.dtype == "bool" else _math.bitwise_and(s, o)
+Tensor.__or__ = lambda s, o: _math.logical_or(s, o) if s.dtype == "bool" else _math.bitwise_or(s, o)
+Tensor.__xor__ = lambda s, o: _math.logical_xor(s, o) if s.dtype == "bool" else _math.bitwise_xor(s, o)
+Tensor.__eq__ = lambda s, o: _math.equal(s, o)
+Tensor.__ne__ = lambda s, o: _math.not_equal(s, o)
+Tensor.__lt__ = lambda s, o: _math.less_than(s, o)
+Tensor.__le__ = lambda s, o: _math.less_equal(s, o)
+Tensor.__gt__ = lambda s, o: _math.greater_than(s, o)
+Tensor.__ge__ = lambda s, o: _math.greater_equal(s, o)
+
+Tensor.__iadd__ = lambda s, o: _math.add_(s, o)
+Tensor.__isub__ = lambda s, o: _math.subtract_(s, o)
+Tensor.__imul__ = lambda s, o: _math.multiply_(s, o)
+Tensor.__itruediv__ = lambda s, o: _math.divide_(s, o)
+
+
+# ---------------------------------------------------------------------------
+# method patching (x.foo(...) == ops.foo(x, ...))
+# ---------------------------------------------------------------------------
+
+_METHOD_SOURCES = (_math, _creation, _manipulation, _reduction, _search, _random, _linalg)
+
+_SKIP = {
+    "zeros", "ones", "full", "empty", "arange", "linspace", "logspace", "eye",
+    "meshgrid", "rand", "randn", "randint", "randperm", "uniform", "normal",
+    "gaussian", "standard_normal", "is_tensor", "broadcast_shape",
+    "scatter_nd", "complex",
+}
+
+
+def _patch_methods():
+    for mod in _METHOD_SOURCES:
+        for name in dir(mod):
+            if name.startswith("_") or name in _SKIP:
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn) or isinstance(fn, type):
+                continue
+            if getattr(fn, "__module__", "").startswith("jax"):
+                continue
+            if not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
+
+
+_patch_methods()
+
+# paddle-specific method aliases
+Tensor.mean = _reduction.mean
+Tensor.sum = _reduction.sum
+Tensor.max = _reduction.max
+Tensor.min = _reduction.min
+Tensor.matmul = _math.matmul
+Tensor.mm = _math.mm
+Tensor.dot = _math.dot
+Tensor.t = _manipulation.t
+Tensor.reshape = _manipulation.reshape
+Tensor.unsqueeze = _manipulation.unsqueeze
+Tensor.squeeze = _manipulation.squeeze
+Tensor.fill_ = _manipulation.fill_
+Tensor.zero_ = _manipulation.zero_
+Tensor.uniform_ = _random.uniform_
+Tensor.normal_ = _random.normal_
+Tensor.set_value = _manipulation.set_value_

@@ -1,0 +1,66 @@
+"""Compact op-definition factories (the framework's analogue of the
+reference's ops.yaml codegen — SURVEY.md §2.1 'Op YAML + codegen': one table
+stamps out the Python API, autograd recording, and XLA lowering at once)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .dispatch import apply, coerce
+from ..tensor import Tensor
+
+
+def _is_scalar(x):
+    return isinstance(x, (bool, int, float, complex))
+
+
+def unary_op(name, fn):
+    def op(x, name=None):
+        x = coerce(x)
+        return apply(fn, [x], name=name or op_name)
+
+    op_name = name
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+def binary_op(name, fn, reverse=False):
+    def op(x, y, name=None):
+        if _is_scalar(y) and isinstance(x, Tensor):
+            return apply(lambda a: fn(a, y), [x], name=op_name)
+        if _is_scalar(x) and isinstance(y, Tensor):
+            return apply(lambda b: fn(x, b), [y], name=op_name)
+        x, y = coerce(x), coerce(y)
+        return apply(fn, [x, y], name=op_name)
+
+    op_name = name
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+def inplace_variant(op):
+    from .dispatch import inplace_rebind
+
+    def op_(x, *args, **kwargs):
+        return inplace_rebind(x, op(x, *args, **kwargs))
+
+    op_.__name__ = op.__name__ + "_"
+    return op_
+
+
+def reduce_op(name, fn):
+    """fn(a, axis, keepdims) -> array."""
+
+    def op(x, axis=None, keepdim=False, name=None):
+        x = coerce(x)
+        if isinstance(axis, (list, tuple)):
+            axis = tuple(int(a) for a in axis)
+        elif axis is not None and not isinstance(axis, int):
+            axis = int(axis)
+        return apply(lambda a: fn(a, axis, keepdim), [x], name=op_name)
+
+    op_name = name
+    op.__name__ = name
+    return op

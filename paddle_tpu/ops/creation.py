@@ -1,0 +1,199 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework import core as _core
+from ..tensor import Tensor
+from .dispatch import apply, coerce, wrap
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        dtype = default or _core.get_default_dtype()
+    return _core.to_jax_dtype(_core.convert_dtype(dtype))
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in shape.numpy().tolist()]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s._data) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def zeros(shape, dtype=None, name=None):
+    return wrap(jnp.zeros(_shape_list(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return wrap(jnp.ones(_shape_list(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = (
+            "bool"
+            if isinstance(fill_value, bool)
+            else "int64"
+            if isinstance(fill_value, int)
+            else _core.get_default_dtype()
+        )
+    return wrap(jnp.full(_shape_list(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.zeros_like(a, dtype=_dt(dtype, x.dtype)), [x.detach()])
+
+
+def ones_like(x, dtype=None, name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.ones_like(a, dtype=_dt(dtype, x.dtype)), [x.detach()])
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = coerce(x)
+    return apply(
+        lambda a: jnp.full_like(a, fill_value, dtype=_dt(dtype, x.dtype)), [x.detach()]
+    )
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    start, end, step = val(start), val(end), val(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (
+            "int64"
+            if all(isinstance(v, (int, np.integer)) for v in (start, end, step))
+            else _core.get_default_dtype()
+        )
+    return wrap(jnp.arange(start, end, step, _dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    return wrap(jnp.linspace(val(start), val(stop), int(val(num)), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    return wrap(
+        jnp.logspace(val(start), val(stop), int(val(num)), base=val(base), dtype=_dt(dtype))
+    )
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return wrap(jnp.eye(int(num_rows), num_columns and int(num_columns), dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = coerce(x)
+
+    def f(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.diag(jnp.ones_like(a, dtype=bool), k=offset)
+                out = jnp.where(mask, out, padding_value)
+            return out
+        return jnp.diag(a, k=offset)
+
+    return apply(f, [x], name="diag")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    x = coerce(x)
+
+    def f(a):
+        out = jnp.zeros(a.shape + (a.shape[-1] + abs(offset),), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = out[..., : a.shape[-1] + abs(offset)]
+        base = jnp.zeros(a.shape[:-1] + (a.shape[-1] + abs(offset), a.shape[-1] + abs(offset)), a.dtype)
+        base = base.at[..., r, c].set(a)
+        return jnp.moveaxis(jnp.moveaxis(base, -2, dim1), -1, dim2) if (dim1, dim2) != (-2, -1) else base
+
+    return apply(f, [x], name="diag_embed")
+
+
+def diagflat(x, offset=0, name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.diagflat(a, k=offset), [x], name="diagflat")
+
+
+def tril(x, diagonal=0, name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.tril(a, k=diagonal), [x], name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.triu(a, k=diagonal), [x], name="triu")
+
+
+def meshgrid(*args, name=None):
+    args = [coerce(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    return list(apply(lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")), args, multi=True))
+
+
+def assign(x, output=None, name=None):
+    x = coerce(x)
+    out = apply(lambda a: a + 0 if jnp.issubdtype(a.dtype, jnp.inexact) else jnp.array(a), [x], name="assign")
+    if output is not None:
+        from .dispatch import inplace_rebind
+
+        return inplace_rebind(output, out)
+    return out
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+def tolist(x):
+    return coerce(x).tolist()
+
+
+def numel(x, name=None):
+    return wrap(jnp.asarray(coerce(x).size, jnp.int64))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def complex(real, imag, name=None):
+    real, imag = coerce(real), coerce(imag)
+    return apply(lambda r, i: r + 1j * i, [real, imag], name="complex")
+
+
+def as_complex(x, name=None):
+    x = coerce(x)
+    return apply(lambda a: a[..., 0] + 1j * a[..., 1], [x], name="as_complex")
+
+
+def as_real(x, name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.stack([a.real, a.imag], -1), [x], name="as_real")

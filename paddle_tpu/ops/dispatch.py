@@ -1,0 +1,167 @@
+"""Op dispatcher — the single Python→XLA boundary.
+
+Replaces the reference's kernel dispatch stack (phi::KernelFactory selection +
+generated ad_funcs, SURVEY.md §3.1): every framework op is a jax-traceable
+function over arrays; `apply()` executes it (eagerly via jax's op cache, or
+symbolically under @to_static tracing) and, when autograd is live, records one
+GradNode whose VJP comes from `jax.vjp`.  AMP O1 casting hooks in here too
+(reference: paddle/fluid/eager/amp_utils.h).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import core as _core
+
+
+def _is_inexact(arr):
+    return jnp.issubdtype(jnp.dtype(arr.dtype), jnp.inexact)
+
+
+def wrap(arr, stop_gradient=True):
+    from ..tensor import Tensor
+
+    t = Tensor.__new__(Tensor)
+    return t._init_from_array(arr, stop_gradient=stop_gradient)
+
+
+def coerce(x, dtype=None):
+    """Promote python scalars / numpy / jax arrays to Tensor."""
+    from ..tensor import Tensor
+
+    if isinstance(x, Tensor):
+        return x
+    if isinstance(x, (bool, int, float, complex)):
+        if dtype is None:
+            if isinstance(x, bool):
+                dtype = "bool"
+            elif isinstance(x, int):
+                dtype = "int64"
+            elif isinstance(x, float):
+                dtype = _core.get_default_dtype()
+            else:
+                dtype = "complex64"
+        return wrap(jnp.asarray(x, _core.to_jax_dtype(dtype)))
+    if isinstance(x, jax.Array) or isinstance(x, jax.core.Tracer):
+        return wrap(x)
+    return Tensor(x, dtype=dtype)
+
+
+def apply(fn, inputs, name=None, multi=False, outputs_stop_gradient=None):
+    """Execute `fn(*arrays)` over the inputs' payloads; record autograd.
+
+    fn        : jax-traceable callable, one positional arg per input tensor.
+    inputs    : list[Tensor]
+    multi     : fn returns a tuple of arrays (else a single array)
+    outputs_stop_gradient : optional list[bool] forcing per-output flags
+    """
+    from .. import autograd  # noqa: F401  (ensures engine import)
+    from ..autograd.engine import GradNode
+
+    arrays = [t._data for t in inputs]
+    record = _core.grad_enabled() and any(
+        (not t.stop_gradient) and _is_inexact(a) for t, a in zip(inputs, arrays)
+    )
+
+    if not record:
+        out = fn(*arrays)
+        outs = out if multi else (out,)
+        tensors = tuple(wrap(o) for o in outs)
+        if outputs_stop_gradient is not None:
+            for t, sg in zip(tensors, outputs_stop_gradient):
+                t.stop_gradient = sg
+        return tensors if multi else tensors[0]
+
+    diff_idx = [
+        i
+        for i, (t, a) in enumerate(zip(inputs, arrays))
+        if (not t.stop_gradient) and _is_inexact(a)
+    ]
+
+    def f(*diff):
+        buf = list(arrays)
+        for i, a in zip(diff_idx, diff):
+            buf[i] = a
+        r = fn(*buf)
+        return r if multi else (r,)
+
+    primals = [arrays[i] for i in diff_idx]
+    outs, vjp_fn = jax.vjp(f, *primals)
+
+    tensors = tuple(
+        wrap(o, stop_gradient=not _is_inexact(o)) for o in outs
+    )
+    if outputs_stop_gradient is not None:
+        for t, sg in zip(tensors, outputs_stop_gradient):
+            t.stop_gradient = sg
+
+    node = GradNode(
+        name or getattr(fn, "__name__", "op"),
+        f,
+        vjp_fn,
+        [inputs[i] for i in diff_idx],
+        tensors,
+    )
+    for j, t in enumerate(tensors):
+        if not t.stop_gradient:
+            t._grad_node = node
+            t._out_index = j
+    if _core.flag("FLAGS_check_nan_inf"):
+        _check_nan_inf(name or "op", tensors)
+    return tensors if multi else tensors[0]
+
+
+def _check_nan_inf(name, tensors):
+    """FLAGS_check_nan_inf (reference: nan_inf_utils_detail) — eager only."""
+    for t in tensors:
+        a = t._raw
+        if isinstance(a, jax.core.Tracer):
+            return
+        if _is_inexact(a) and not bool(jnp.isfinite(a).all()):
+            raise FloatingPointError(f"NaN or Inf found in output of op '{name}'")
+
+
+def inplace_rebind(target, result):
+    """Make `target` alias `result` (data + autograd) — the in-place contract.
+
+    The reference tracks in-place via version counters on shared buffers
+    (paddle/fluid/eager/*); on XLA buffers are immutable, so `add_`-style ops
+    compute functionally then rebind, keeping tape linkage intact.
+    """
+    target._data = result._data
+    target._grad_node = result._grad_node
+    target._out_index = result._out_index
+    if not result.stop_gradient:
+        target.stop_gradient = False
+    return target
+
+
+# ---------------------------------------------------------------------------
+# AMP hook (O1): cast inputs for white-listed ops when auto_cast is active
+# ---------------------------------------------------------------------------
+
+
+def amp_cast_inputs(tensors, list_kind):
+    """list_kind: 'white' (cast to amp dtype) or 'black' (cast to float32)."""
+    amp = _core.active_amp()
+    if amp is None or not amp.enabled or amp.level not in ("O1", "O2"):
+        return tensors
+    from . import cast as _cast
+
+    out = []
+    if list_kind == "white":
+        target = amp.dtype
+        for t in tensors:
+            if t.dtype in ("float32", "float16", "bfloat16") and t.dtype != target:
+                out.append(_cast(t, target))
+            else:
+                out.append(t)
+    else:  # black
+        for t in tensors:
+            if t.dtype in ("float16", "bfloat16"):
+                out.append(_cast(t, "float32"))
+            else:
+                out.append(t)
+    return out

@@ -1,0 +1,196 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py → PHI
+lapack/cublas kernels; here XLA's native linalg lowerings)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from .dispatch import apply, coerce
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = coerce(x)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+
+    def f(a):
+        if p is None or p == "fro":
+            if ax is None:
+                return jnp.sqrt(jnp.sum(jnp.square(a)))
+            return jnp.linalg.norm(a, ord=None, axis=ax, keepdims=keepdim)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+    return apply(f, [x], name="norm")
+
+
+def dist(x, y, p=2, name=None):
+    x, y = coerce(x), coerce(y)
+
+    def f(a, b):
+        d = a - b
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == 0:
+            return jnp.sum((d != 0).astype(d.dtype))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+    return apply(f, [x, y], name="dist")
+
+
+def cholesky(x, upper=False, name=None):
+    x = coerce(x)
+
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+
+    return apply(f, [x], name="cholesky")
+
+
+def qr(x, mode="reduced", name=None):
+    x = coerce(x)
+    q, r = apply(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), [x], multi=True, name="qr")
+    return q, r
+
+
+def svd(x, full_matrices=False, name=None):
+    x = coerce(x)
+    u, s, vh = apply(
+        lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+        [x],
+        multi=True,
+        name="svd",
+    )
+    return u, s, vh
+
+
+def inverse(x, name=None):
+    x = coerce(x)
+    return apply(jnp.linalg.inv, [x], name="inverse")
+
+
+inv = inverse
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.linalg.pinv(a, rcond=rcond, hermitian=hermitian), [x])
+
+
+def solve(x, y, name=None):
+    x, y = coerce(x), coerce(y)
+    return apply(jnp.linalg.solve, [x, y], name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    x, y = coerce(x), coerce(y)
+    import jax
+
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        )
+
+    return apply(f, [x, y], name="triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = coerce(x), coerce(y)
+    sol, res, rank, sv = apply(
+        lambda a, b: tuple(jnp.linalg.lstsq(a, b, rcond=rcond)), [x, y], multi=True
+    )
+    return sol, res, rank, sv
+
+
+def matrix_power(x, n, name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.linalg.matrix_power(a, n), [x], name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.linalg.matrix_rank(a, tol=tol), [x], name="matrix_rank")
+
+
+def slogdet(x, name=None):
+    x = coerce(x)
+    s, l = apply(lambda a: tuple(jnp.linalg.slogdet(a)), [x], multi=True, name="slogdet")
+    return s, l
+
+
+def det(x, name=None):
+    x = coerce(x)
+    return apply(jnp.linalg.det, [x], name="det")
+
+
+def eig(x, name=None):
+    x = coerce(x)
+    import numpy as np
+
+    w, v = np.linalg.eig(np.asarray(x._data))
+    from .dispatch import wrap
+
+    return wrap(jnp.asarray(w)), wrap(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    x = coerce(x)
+    w, v = apply(lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), [x], multi=True)
+    return w, v
+
+
+def eigvals(x, name=None):
+    w, _ = eig(x)
+    return w
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), [x], name="eigvalsh")
+
+
+def multi_dot(x, name=None):
+    xs = [coerce(v) for v in x]
+    return apply(lambda *arrs: jnp.linalg.multi_dot(arrs), xs, name="multi_dot")
+
+
+def cond(x, p=None, name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.linalg.cond(a, p=p), [x], name="cond")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    x = coerce(x)
+    return apply(
+        lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0), [x], name="cov"
+    )
+
+
+def corrcoef(x, rowvar=True, name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.corrcoef(a, rowvar=rowvar), [x], name="corrcoef")
+
+
+def householder_product(x, tau, name=None):
+    x, tau = coerce(x), coerce(tau)
+
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(n):
+            v = jnp.concatenate([jnp.zeros(i, a.dtype), jnp.ones(1, a.dtype), a[i + 1 :, i]])
+            q = q - t[i] * (q @ v)[:, None] * v[None, :]
+        return q[:, :n]
+
+    return apply(f, [x, tau], name="householder_product")
+
+
+def einsum(equation, *operands):
+    ops_ = [coerce(o) for o in operands]
+    return apply(lambda *arrs: jnp.einsum(equation, *arrs), ops_, name="einsum")

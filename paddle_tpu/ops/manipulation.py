@@ -1,0 +1,608 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py)."""
+
+from __future__ import annotations
+
+import builtins
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import core as _core
+from ..tensor import Tensor
+from ._factory import inplace_variant
+from .dispatch import apply, coerce, inplace_rebind, wrap
+
+
+def _ints(v):
+    if isinstance(v, Tensor):
+        return [int(s) for s in v.numpy().tolist()]
+    if isinstance(v, (int, np.integer)):
+        return [int(v)]
+    return [int(s._data) if isinstance(s, Tensor) else int(s) for s in v]
+
+
+def cast(x, dtype, name=None):
+    x = coerce(x)
+    jdt = _core.to_jax_dtype(_core.convert_dtype(dtype))
+    return apply(lambda a: a.astype(jdt), [x], name="cast")
+
+
+cast_ = inplace_variant(cast)
+
+
+def reshape(x, shape, name=None):
+    x = coerce(x)
+    shape = _ints(shape)
+    return apply(lambda a: jnp.reshape(a, shape), [x], name="reshape")
+
+
+reshape_ = inplace_variant(reshape)
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return cast(x, shape_or_dtype)
+
+
+def transpose(x, perm=None, name=None):
+    x = coerce(x)
+    if perm is None:
+        perm = list(range(x.ndim))[::-1]
+    perm = _ints(perm)
+    return apply(lambda a: jnp.transpose(a, perm), [x], name="transpose")
+
+
+transpose_ = inplace_variant(transpose)
+
+
+def t(x, name=None):
+    x = coerce(x)
+    if x.ndim < 2:
+        return assign_alias(x)
+    return apply(lambda a: jnp.swapaxes(a, -1, -2), [x], name="t")
+
+
+def assign_alias(x):
+    return apply(lambda a: a, [coerce(x)], name="identity")
+
+
+def moveaxis(x, source, destination, name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.moveaxis(a, source, destination), [x], name="moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.swapaxes(a, axis0, axis1), [x], name="swapaxes")
+
+
+transpose2 = swapaxes
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = coerce(x)
+    nd = x.ndim
+    sa = start_axis % nd if nd else 0
+    ea = stop_axis % nd if nd else 0
+
+    def f(a):
+        shape = a.shape
+        newshape = shape[:sa] + (-1,) + shape[ea + 1 :]
+        return jnp.reshape(a, newshape)
+
+    return apply(f, [x], name="flatten")
+
+
+flatten_ = inplace_variant(flatten)
+
+
+def squeeze(x, axis=None, name=None):
+    x = coerce(x)
+    if axis is None:
+        ax = None
+    else:
+        ax = tuple(a % builtins.max(x.ndim, 1) for a in _ints(axis) )
+        ax = tuple(a for a in ax if x.shape[a] == 1)
+    return apply(lambda a: jnp.squeeze(a, ax), [x], name="squeeze")
+
+
+squeeze_ = inplace_variant(squeeze)
+
+
+def unsqueeze(x, axis, name=None):
+    x = coerce(x)
+    ax = _ints(axis)
+    return apply(lambda a: jnp.expand_dims(a, ax), [x], name="unsqueeze")
+
+
+unsqueeze_ = inplace_variant(unsqueeze)
+
+
+def concat(x, axis=0, name=None):
+    xs = [coerce(v) for v in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.numpy())
+    return apply(lambda *arrs: jnp.concatenate(arrs, axis=axis), xs, name="concat")
+
+
+def stack(x, axis=0, name=None):
+    xs = [coerce(v) for v in x]
+    return apply(lambda *arrs: jnp.stack(arrs, axis=axis), xs, name="stack")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = coerce(x)
+    n = num or x.shape[axis]
+    return list(
+        apply(
+            lambda a: tuple(jnp.moveaxis(a, axis, 0)[i] for i in range(n)),
+            [x],
+            multi=True,
+            name="unstack",
+        )
+    )
+
+
+def unbind(input, axis=0, name=None):
+    return unstack(input, axis)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = coerce(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.numpy())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = _ints(num_or_sections)
+        n_unknown = builtins.sum(1 for s in sizes if s < 0)
+        if n_unknown:
+            known = builtins.sum(s for s in sizes if s >= 0)
+            sizes = [s if s >= 0 else dim - known for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    def f(a):
+        return tuple(
+            jax.lax.slice_in_dim(a, o, o + s, axis=axis) for o, s in zip(offsets, sizes)
+        )
+
+    return list(apply(f, [x], multi=True, name="split"))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    x = coerce(x)
+    dim = x.shape[axis]
+    if isinstance(num_or_indices, int):
+        base, rem = divmod(dim, num_or_indices)
+        sizes = [base + (1 if i < rem else 0) for i in range(num_or_indices)]
+        return split(x, sizes, axis)
+    idx = _ints(num_or_indices)
+    sizes = []
+    prev = 0
+    for i in idx:
+        sizes.append(i - prev)
+        prev = i
+    sizes.append(dim - prev)
+    return split(x, sizes, axis)
+
+
+def tile(x, repeat_times, name=None):
+    x = coerce(x)
+    reps = _ints(repeat_times)
+    return apply(lambda a: jnp.tile(a, reps), [x], name="tile")
+
+
+def expand(x, shape, name=None):
+    x = coerce(x)
+    shape = _ints(shape)
+    cur = x.shape
+    full = list(shape)
+    # -1 entries keep the original dim
+    off = len(full) - len(cur)
+    for i, s in enumerate(full):
+        if s == -1:
+            full[i] = cur[i - off]
+    return apply(lambda a: jnp.broadcast_to(a, full), [x], name="expand")
+
+
+def expand_as(x, y, name=None):
+    return expand(x, coerce(y).shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    xs = [coerce(v) for v in inputs]
+    return list(apply(lambda *arrs: tuple(jnp.broadcast_arrays(*arrs)), xs, multi=True))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def flip(x, axis, name=None):
+    x = coerce(x)
+    ax = _ints(axis)
+    return apply(lambda a: jnp.flip(a, ax), [x], name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.rot90(a, k, axes), [x], name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    x = coerce(x)
+    sh = _ints(shifts) if not isinstance(shifts, int) else shifts
+    ax = _ints(axis) if axis is not None and not isinstance(axis, int) else axis
+    if isinstance(sh, list) and len(sh) == 1:
+        sh = sh[0]
+    if isinstance(ax, list) and len(ax) == 1:
+        ax = ax[0]
+    return apply(lambda a: jnp.roll(a, sh, ax), [x], name="roll")
+
+
+def slice(input, axes, starts, ends, name=None):
+    x = coerce(input)
+    axes, starts, ends = _ints(axes), _ints(starts), _ints(ends)
+
+    def f(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[ax] = builtins.slice(s, e)
+        return a[tuple(idx)]
+
+    return apply(f, [x], name="slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = coerce(x)
+    shape = _ints(shape)
+    offsets = _ints(offsets) if offsets is not None else [0] * len(shape)
+
+    def f(a):
+        idx = tuple(
+            builtins.slice(o, o + (s if s != -1 else a.shape[i] - o))
+            for i, (o, s) in enumerate(zip(offsets, shape))
+        )
+        return a[idx]
+
+    return apply(f, [x], name="crop")
+
+
+def gather(x, index, axis=0, name=None):
+    x, index = coerce(x), coerce(index)
+    if isinstance(axis, Tensor):
+        axis = int(axis.numpy())
+    return apply(lambda a, i: jnp.take(a, i.astype(jnp.int32), axis=axis), [x, index], name="gather")
+
+
+def gather_nd(x, index, name=None):
+    x, index = coerce(x), coerce(index)
+
+    def f(a, i):
+        i = i.astype(jnp.int32)
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a[idx]
+
+    return apply(f, [x, index], name="gather_nd")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    arr, indices = coerce(arr), coerce(indices)
+    return apply(
+        lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=axis),
+        [arr, indices],
+        name="take_along_axis",
+    )
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    arr, indices = coerce(arr), coerce(indices)
+    values = coerce(values)
+
+    def f(a, i, v):
+        i = i.astype(jnp.int32)
+        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+        dims = [jnp.arange(s) for s in i.shape]
+        grids = jnp.meshgrid(*dims, indexing="ij")
+        idx = tuple(grids[d] if d != axis else i for d in range(a.ndim))
+        if reduce == "assign":
+            return a.at[idx].set(v)
+        if reduce in ("add", "sum"):
+            return a.at[idx].add(v)
+        if reduce in ("mul", "multiply"):
+            return a.at[idx].multiply(v)
+        raise ValueError(reduce)
+
+    return apply(f, [arr, indices, values], name="put_along_axis")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    x, index, updates = coerce(x), coerce(index), coerce(updates)
+
+    def f(a, i, u):
+        i = i.astype(jnp.int32).reshape(-1)
+        if overwrite:
+            return a.at[i].set(u.astype(a.dtype))
+        return a.at[i].add(u.astype(a.dtype))
+
+    return apply(f, [x, index, updates], name="scatter")
+
+
+scatter_ = inplace_variant(scatter)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index, updates = coerce(x), coerce(index), coerce(updates)
+
+    def f(a, i, u):
+        idx = tuple(jnp.moveaxis(i.astype(jnp.int32), -1, 0))
+        return a.at[idx].add(u.astype(a.dtype))
+
+    return apply(f, [x, index, updates], name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    index, updates = coerce(index), coerce(updates)
+    shape = _ints(shape)
+
+    def f(i, u):
+        z = jnp.zeros(shape, u.dtype)
+        idx = tuple(jnp.moveaxis(i.astype(jnp.int32), -1, 0))
+        return z.at[idx].add(u)
+
+    return apply(f, [index, updates], name="scatter_nd")
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def index_sample(x, index, name=None):
+    x, index = coerce(x), coerce(index)
+    return apply(
+        lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=1),
+        [x, index],
+        name="index_sample",
+    )
+
+
+def index_add(x, index, axis, value, name=None):
+    x, index, value = coerce(x), coerce(index), coerce(value)
+
+    def f(a, i, v):
+        i = i.astype(jnp.int32)
+        a2 = jnp.moveaxis(a, axis, 0)
+        v2 = jnp.moveaxis(v, axis, 0)
+        out = a2.at[i].add(v2.astype(a.dtype))
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply(f, [x, index, value], name="index_add")
+
+
+index_add_ = inplace_variant(index_add)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = coerce(x)
+    idx_ts = [coerce(i) for i in indices]
+    value = coerce(value)
+
+    def f(a, v, *idx):
+        key = tuple(i.astype(jnp.int32) if jnp.issubdtype(i.dtype, jnp.integer) else i for i in idx)
+        if accumulate:
+            return a.at[key].add(v.astype(a.dtype))
+        return a.at[key].set(v.astype(a.dtype))
+
+    return apply(f, [x, value] + idx_ts, name="index_put")
+
+
+def masked_select(x, mask, name=None):
+    x, mask = coerce(x), coerce(mask)
+    # dynamic output shape: eager-only (documented; mirror of reference's
+    # masked_select which is also shape-dynamic)
+    return wrap(x._data[mask._data.astype(bool)])
+
+
+def masked_fill(x, mask, value, name=None):
+    x, mask = coerce(x), coerce(mask)
+    if isinstance(value, Tensor):
+        return apply(
+            lambda a, m, v: jnp.where(m.astype(bool), v.astype(a.dtype), a),
+            [x, mask, value],
+            name="masked_fill",
+        )
+    return apply(
+        lambda a, m: jnp.where(m.astype(bool), jnp.asarray(value, a.dtype), a),
+        [x, mask],
+        name="masked_fill",
+    )
+
+
+masked_fill_ = inplace_variant(masked_fill)
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = coerce(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    x, y = coerce(x), coerce(y)
+    return apply(
+        lambda c, a, b: jnp.where(c.astype(bool), a, b), [condition, x, y], name="where"
+    )
+
+
+def nonzero(x, as_tuple=False, name=None):
+    x = coerce(x)
+    arr = np.asarray(x._data)  # dynamic shape → host (eager only)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(wrap(jnp.asarray(i)) for i in nz)
+    return wrap(jnp.asarray(np.stack(nz, axis=1)))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = coerce(x)
+    if isinstance(repeats, Tensor):
+        reps = repeats._data
+
+        def f(a, r):
+            return jnp.repeat(a, r, axis=axis, total_repeat_length=int(np.sum(np.asarray(r))))
+
+        return apply(f, [x, repeats], name="repeat_interleave")
+    return apply(lambda a: jnp.repeat(a, repeats, axis=axis), [x], name="repeat_interleave")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = coerce(x)
+    pad = _ints(pad)
+    nd = x.ndim
+
+    if len(pad) == 2 * nd:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle convention: pad applies to last len(pad)//2 spatial dims,
+        # ordered from last dim backwards: [left,right, top,bottom, ...]
+        width = [(0, 0)] * nd
+        npairs = len(pad) // 2
+        if data_format.upper().endswith("C"):  # NHWC / NLC / NDHWC
+            spatial = list(range(1, 1 + npairs))
+        else:  # NCHW-style
+            spatial = list(range(nd - npairs, nd))
+        for k, axis_i in enumerate(reversed(spatial)):
+            width[axis_i] = (pad[2 * k], pad[2 * k + 1])
+
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+
+    def f(a):
+        if jmode == "constant":
+            return jnp.pad(a, width, mode="constant", constant_values=value)
+        return jnp.pad(a, width, mode=jmode)
+
+    return apply(f, [x], name="pad")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = coerce(x)
+    axes, starts, ends, strides = _ints(axes), _ints(starts), _ints(ends), _ints(strides)
+
+    def f(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(s, e, st)
+        return a[tuple(idx)]
+
+    return apply(f, [x], name="strided_slice")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    x = coerce(x)
+    arr = np.asarray(x._data)
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse, return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return wrap(jnp.asarray(res))
+    outs = [wrap(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    x = coerce(x)
+    arr = np.asarray(x._data)
+    vals = []
+    counts = []
+    flat = arr.flatten() if axis is None else arr
+    prev = None
+    for v in flat:
+        if prev is None or v != prev:
+            vals.append(v)
+            counts.append(1)
+        else:
+            counts[-1] += 1
+        prev = v
+    outs = [wrap(jnp.asarray(np.array(vals)))]
+    if return_inverse:
+        inv = np.concatenate([[i] * c for i, c in enumerate(counts)]) if counts else np.array([], dtype=np.int32)
+        outs.append(wrap(jnp.asarray(inv)))
+    if return_counts:
+        outs.append(wrap(jnp.asarray(np.array(counts))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def fill_diagonal_(x, value, offset=0, wrap_=False, name=None):
+    def f(a):
+        n = builtins.min(a.shape[-2], a.shape[-1])
+        idx = jnp.arange(n - builtins.abs(offset))
+        r = idx + builtins.max(-offset, 0)
+        c = idx + builtins.max(offset, 0)
+        return a.at[..., r, c].set(value)
+
+    return inplace_rebind(x, apply(f, [coerce(x)], name="fill_diagonal"))
+
+
+def fill_(x, value):
+    return inplace_rebind(x, apply(lambda a: jnp.full_like(a, value), [coerce(x)], name="fill"))
+
+
+def zero_(x):
+    return fill_(x, 0.0)
+
+
+def one_hot(x, num_classes, name=None):
+    x = coerce(x)
+    return apply(
+        lambda a: jax.nn.one_hot(a.astype(jnp.int32), num_classes, dtype=jnp.float32),
+        [x],
+        name="one_hot",
+    )
+
+
+def set_value_(x, value):
+    """Replace payload (used by optimizers / state loading)."""
+    value = coerce(value)
+    x._data = value._data.astype(x._data.dtype)
+    return x
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = coerce(x)
+    if weights is not None:
+        weights = coerce(weights)
+        length = int(builtins.max(int(np.asarray(x._data).max(initial=0)) + 1, minlength))
+        return apply(
+            lambda a, w: jnp.bincount(a.astype(jnp.int32), w, length=length),
+            [x, weights],
+            name="bincount",
+        )
+    length = int(builtins.max(int(np.asarray(x._data).max(initial=0)) + 1, minlength))
+    return apply(lambda a: jnp.bincount(a.astype(jnp.int32), length=length), [x], name="bincount")
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    x = coerce(input)
+    arr = np.asarray(x._data)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (arr.min(), arr.max())
+    h, _ = np.histogram(arr, bins=bins, range=(lo, hi))
+    return wrap(jnp.asarray(h))
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    x = coerce(x)
+    arr = np.lib.stride_tricks.as_strided(
+        np.asarray(x._data).reshape(-1)[offset:],
+        shape=shape,
+        strides=[s * x.element_size() for s in stride],
+    )
+    return wrap(jnp.asarray(arr.copy()))
+
+
+def view_as(x, other, name=None):
+    return reshape(x, coerce(other).shape)

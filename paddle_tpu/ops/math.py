@@ -1,0 +1,300 @@
+"""Math ops (reference surface: python/paddle/tensor/math.py over PHI kernels;
+here each op is a direct XLA lowering via jnp/lax — SURVEY.md §2.3)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework import core as _core
+from ..tensor import Tensor
+from ._factory import binary_op, inplace_variant, unary_op, _is_scalar
+from .dispatch import apply, coerce, amp_cast_inputs, inplace_rebind
+
+# -- binary -----------------------------------------------------------------
+add = binary_op("add", jnp.add)
+subtract = binary_op("subtract", jnp.subtract)
+multiply = binary_op("multiply", jnp.multiply)
+divide = binary_op("divide", jnp.divide)
+floor_divide = binary_op("floor_divide", jnp.floor_divide)
+remainder = binary_op("remainder", jnp.remainder)
+mod = remainder
+floor_mod = remainder
+pow = binary_op("pow", jnp.power)
+maximum = binary_op("maximum", jnp.maximum)
+minimum = binary_op("minimum", jnp.minimum)
+fmax = binary_op("fmax", jnp.fmax)
+fmin = binary_op("fmin", jnp.fmin)
+atan2 = binary_op("atan2", jnp.arctan2)
+hypot = binary_op("hypot", jnp.hypot)
+logaddexp = binary_op("logaddexp", jnp.logaddexp)
+heaviside = binary_op("heaviside", jnp.heaviside)
+copysign = binary_op("copysign", jnp.copysign)
+nextafter = binary_op("nextafter", jnp.nextafter)
+gcd = binary_op("gcd", jnp.gcd)
+lcm = binary_op("lcm", jnp.lcm)
+
+add_ = inplace_variant(add)
+subtract_ = inplace_variant(subtract)
+multiply_ = inplace_variant(multiply)
+divide_ = inplace_variant(divide)
+remainder_ = inplace_variant(remainder)
+floor_divide_ = inplace_variant(floor_divide)
+pow_ = inplace_variant(pow)
+
+# -- unary ------------------------------------------------------------------
+exp = unary_op("exp", jnp.exp)
+expm1 = unary_op("expm1", jnp.expm1)
+log = unary_op("log", jnp.log)
+log2 = unary_op("log2", jnp.log2)
+log10 = unary_op("log10", jnp.log10)
+log1p = unary_op("log1p", jnp.log1p)
+sqrt = unary_op("sqrt", jnp.sqrt)
+rsqrt = unary_op("rsqrt", lax.rsqrt)
+square = unary_op("square", jnp.square)
+sin = unary_op("sin", jnp.sin)
+cos = unary_op("cos", jnp.cos)
+tan = unary_op("tan", jnp.tan)
+asin = unary_op("asin", jnp.arcsin)
+acos = unary_op("acos", jnp.arccos)
+atan = unary_op("atan", jnp.arctan)
+sinh = unary_op("sinh", jnp.sinh)
+cosh = unary_op("cosh", jnp.cosh)
+tanh = unary_op("tanh", jnp.tanh)
+asinh = unary_op("asinh", jnp.arcsinh)
+acosh = unary_op("acosh", jnp.arccosh)
+atanh = unary_op("atanh", jnp.arctanh)
+abs = unary_op("abs", jnp.abs)
+neg = unary_op("neg", jnp.negative)
+reciprocal = unary_op("reciprocal", jnp.reciprocal)
+floor = unary_op("floor", jnp.floor)
+ceil = unary_op("ceil", jnp.ceil)
+round = unary_op("round", jnp.round)
+trunc = unary_op("trunc", jnp.trunc)
+frac = unary_op("frac", lambda a: a - jnp.trunc(a))
+sign = unary_op("sign", jnp.sign)
+erf = unary_op("erf", jax.scipy.special.erf)
+erfinv = unary_op("erfinv", jax.scipy.special.erfinv)
+lgamma = unary_op("lgamma", jax.scipy.special.gammaln)
+digamma = unary_op("digamma", jax.scipy.special.digamma)
+i0 = unary_op("i0", jax.scipy.special.i0)
+i1 = unary_op("i1", jax.scipy.special.i1)
+sigmoid = unary_op("sigmoid", jax.nn.sigmoid)
+logit = unary_op("logit", jax.scipy.special.logit)
+angle = unary_op("angle", jnp.angle)
+conj = unary_op("conj", jnp.conj)
+real = unary_op("real", jnp.real)
+imag = unary_op("imag", jnp.imag)
+rad2deg = unary_op("rad2deg", jnp.rad2deg)
+deg2rad = unary_op("deg2rad", jnp.deg2rad)
+
+exp_ = inplace_variant(exp)
+sqrt_ = inplace_variant(sqrt)
+rsqrt_ = inplace_variant(rsqrt)
+reciprocal_ = inplace_variant(reciprocal)
+floor_ = inplace_variant(floor)
+ceil_ = inplace_variant(ceil)
+round_ = inplace_variant(round)
+tanh_ = inplace_variant(tanh)
+abs_ = inplace_variant(abs)
+neg_ = inplace_variant(neg)
+
+
+# -- scale / clip / lerp ----------------------------------------------------
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = coerce(x)
+    s = scale._data if isinstance(scale, Tensor) else scale
+    if bias_after_scale:
+        out = apply(lambda a: a * s + bias, [x], name="scale")
+    else:
+        out = apply(lambda a: (a + bias) * s, [x], name="scale")
+    return out
+
+
+scale_ = inplace_variant(scale)
+
+
+def clip(x, min=None, max=None, name=None):
+    x = coerce(x)
+    lo = min._data if isinstance(min, Tensor) else min
+    hi = max._data if isinstance(max, Tensor) else max
+    return apply(lambda a: jnp.clip(a, lo, hi), [x], name="clip")
+
+
+clip_ = inplace_variant(clip)
+
+
+def lerp(x, y, weight, name=None):
+    x, y = coerce(x), coerce(y)
+    if isinstance(weight, Tensor):
+        return apply(lambda a, b, w: a + w * (b - a), [x, y, weight], name="lerp")
+    return apply(lambda a, b: a + weight * (b - a), [x, y], name="lerp")
+
+
+lerp_ = inplace_variant(lerp)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    x = coerce(x)
+    return apply(lambda a: scale_b * jnp.tanh(scale_a * a), [x], name="stanh")
+
+
+def multiplex(inputs, index, name=None):
+    inputs = [coerce(i) for i in inputs]
+    index = coerce(index)
+    return apply(
+        lambda idx, *xs: jnp.stack(xs, 0)[idx.reshape(-1), jnp.arange(xs[0].shape[0])],
+        [index] + inputs,
+        name="multiplex",
+    )
+
+
+def increment(x, value=1.0, name=None):
+    return inplace_rebind(x, apply(lambda a: a + value, [x], name="increment"))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), [x])
+
+
+# -- matmul family ----------------------------------------------------------
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = coerce(x), coerce(y)
+    x, y = amp_cast_inputs([x, y], "white")
+
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+
+    return apply(f, [x, y], name="matmul")
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    x, y = coerce(x), coerce(y)
+    return apply(lambda a, b: (a * b).sum(-1), [x, y], name="dot")
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+def outer(x, y, name=None):
+    x, y = coerce(x), coerce(y)
+    return apply(lambda a, b: jnp.outer(a, b), [x, y], name="outer")
+
+
+def inner(x, y, name=None):
+    x, y = coerce(x), coerce(y)
+    return apply(jnp.inner, [x, y], name="inner")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    input, x, y = coerce(input), coerce(x), coerce(y)
+    return apply(
+        lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), [input, x, y], name="addmm"
+    )
+
+
+def kron(x, y, name=None):
+    x, y = coerce(x), coerce(y)
+    return apply(jnp.kron, [x, y], name="kron")
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = coerce(x), coerce(y)
+    ax = axis if axis != 9 else None
+    if ax is None:
+        # paddle default: first axis with dim 3
+        ax = next(i for i, d in enumerate(x.shape) if d == 3)
+    return apply(lambda a, b: jnp.cross(a, b, axis=ax), [x, y], name="cross")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    x = coerce(x)
+    ins = [x]
+    pre_i = app_i = None
+    if prepend is not None:
+        prepend = coerce(prepend)
+        ins.append(prepend)
+        pre_i = len(ins) - 1
+    if append is not None:
+        append = coerce(append)
+        ins.append(append)
+        app_i = len(ins) - 1
+
+    def f(*arrs):
+        return jnp.diff(
+            arrs[0],
+            n=n,
+            axis=axis,
+            prepend=arrs[pre_i] if pre_i is not None else None,
+            append=arrs[app_i] if app_i is not None else None,
+        )
+
+    return apply(f, ins, name="diff")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.trace(a, offset, axis1, axis2), [x], name="trace")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.diagonal(a, offset, axis1, axis2), [x], name="diagonal")
+
+
+# -- logic / comparison (non-differentiable outputs) ------------------------
+equal = binary_op("equal", jnp.equal)
+not_equal = binary_op("not_equal", jnp.not_equal)
+greater_than = binary_op("greater_than", jnp.greater)
+greater_equal = binary_op("greater_equal", jnp.greater_equal)
+less_than = binary_op("less_than", jnp.less)
+less_equal = binary_op("less_equal", jnp.less_equal)
+logical_and = binary_op("logical_and", jnp.logical_and)
+logical_or = binary_op("logical_or", jnp.logical_or)
+logical_xor = binary_op("logical_xor", jnp.logical_xor)
+logical_not = unary_op("logical_not", jnp.logical_not)
+bitwise_and = binary_op("bitwise_and", jnp.bitwise_and)
+bitwise_or = binary_op("bitwise_or", jnp.bitwise_or)
+bitwise_xor = binary_op("bitwise_xor", jnp.bitwise_xor)
+bitwise_not = unary_op("bitwise_not", jnp.bitwise_not)
+isnan = unary_op("isnan", jnp.isnan)
+isinf = unary_op("isinf", jnp.isinf)
+isfinite = unary_op("isfinite", jnp.isfinite)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = coerce(x), coerce(y)
+    return apply(
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        [x, y],
+        name="isclose",
+    )
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = coerce(x), coerce(y)
+    return apply(
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        [x, y],
+        name="allclose",
+    )
+
+
+def equal_all(x, y, name=None):
+    x, y = coerce(x), coerce(y)
+    return apply(lambda a, b: jnp.array_equal(a, b), [x, y], name="equal_all")

@@ -1,0 +1,150 @@
+"""Random ops (reference: python/paddle/tensor/random.py).
+
+All sampling threads through the global Generator's key (see
+framework/random.py), so randomness is reproducible under `paddle.seed` and
+correctly becomes threaded state inside @to_static-compiled steps.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import core as _core
+from ..framework.random import default_generator
+from ..tensor import Tensor
+from .creation import _dt, _shape_list
+from .dispatch import apply, coerce, wrap, inplace_rebind
+
+
+def _key():
+    return default_generator.next_key()
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    shape = _shape_list(shape)
+    dt = _dt(dtype)
+    key = _key()
+    return wrap(jax.random.uniform(key, shape, dt, minval=min, maxval=max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    out = uniform(x.shape, x.dtype, min, max)
+    return inplace_rebind(x, out)
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    shape = _shape_list(shape)
+    return wrap(jax.random.normal(_key(), shape, _dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        mean_t = coerce(mean)
+        std_t = coerce(std)
+        sh = tuple(np.broadcast_shapes(tuple(mean_t.shape), tuple(std_t.shape)))
+        key = _key()
+        return apply(
+            lambda m, s: m + s * jax.random.normal(key, sh, m.dtype),
+            [mean_t, std_t],
+            name="normal",
+        )
+    shape = _shape_list(shape if shape is not None else [1])
+    return wrap(mean + std * jax.random.normal(_key(), shape, _dt(None)))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    return inplace_rebind(x, coerce(normal(mean, std, x.shape)).astype(x.dtype))
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    shape = _shape_list(shape)
+    return wrap(mean + std * jax.random.normal(_key(), shape, _dt(dtype)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    shape = _shape_list(shape)
+    return wrap(jax.random.randint(_key(), shape, low, high, _dt(dtype, "int64")))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = coerce(x)
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    return wrap(jax.random.permutation(_key(), int(n)).astype(_dt(dtype, "int64")))
+
+
+def shuffle(x, axis=0, name=None):
+    x = coerce(x)
+    key = _key()
+    return apply(lambda a: jax.random.permutation(key, a, axis=axis), [x], name="shuffle")
+
+
+def bernoulli(x, name=None):
+    x = coerce(x)
+    key = _key()
+    return apply(
+        lambda p: jax.random.bernoulli(key, p).astype(p.dtype), [x], name="bernoulli"
+    )
+
+
+def bernoulli_(x, p=0.5, name=None):
+    key = _key()
+    out = apply(
+        lambda a: jax.random.bernoulli(key, p, a.shape).astype(a.dtype),
+        [coerce(x)],
+        name="bernoulli_",
+    )
+    return inplace_rebind(x, out)
+
+
+def poisson(x, name=None):
+    x = coerce(x)
+    key = _key()
+    return apply(lambda lam: jax.random.poisson(key, lam).astype(lam.dtype), [x], name="poisson")
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = coerce(x)
+    key = _key()
+
+    def f(p):
+        logits = jnp.log(jnp.maximum(p, 1e-30))
+        if replacement:
+            return jax.random.categorical(key, logits, axis=-1, shape=(
+                (p.shape[0], num_samples) if p.ndim == 2 else (num_samples,)
+            ) if p.ndim == 2 else (num_samples,))
+        # without replacement: gumbel top-k
+        g = jax.random.gumbel(key, p.shape, p.dtype)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx
+
+    if x.ndim == 2 and replacement:
+        def f2(p):
+            logits = jnp.log(jnp.maximum(p, 1e-30))
+            return jax.random.categorical(key, logits, axis=-1, shape=(num_samples, p.shape[0])).T
+        return apply(f2, [x.detach()], name="multinomial")
+    return apply(f, [x.detach()], name="multinomial")
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = _key()
+    out = apply(
+        lambda a: (jax.random.exponential(key, a.shape, a.dtype) / lam).astype(a.dtype),
+        [coerce(x)],
+        name="exponential_",
+    )
+    return inplace_rebind(x, out)

@@ -1,0 +1,111 @@
+"""Reduction / scan ops (reference: python/paddle/tensor/math.py & stat.py)."""
+
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ._factory import reduce_op
+from .dispatch import apply, coerce
+
+sum = reduce_op("sum", lambda a, ax, kd: jnp.sum(a, axis=ax, keepdims=kd))
+mean = reduce_op("mean", lambda a, ax, kd: jnp.mean(a, axis=ax, keepdims=kd))
+prod = reduce_op("prod", lambda a, ax, kd: jnp.prod(a, axis=ax, keepdims=kd))
+max = reduce_op("max", lambda a, ax, kd: jnp.max(a, axis=ax, keepdims=kd))
+min = reduce_op("min", lambda a, ax, kd: jnp.min(a, axis=ax, keepdims=kd))
+amax = reduce_op("amax", lambda a, ax, kd: jnp.max(a, axis=ax, keepdims=kd))
+amin = reduce_op("amin", lambda a, ax, kd: jnp.min(a, axis=ax, keepdims=kd))
+all = reduce_op("all", lambda a, ax, kd: jnp.all(a.astype(bool), axis=ax, keepdims=kd))
+any = reduce_op("any", lambda a, ax, kd: jnp.any(a.astype(bool), axis=ax, keepdims=kd))
+nansum = reduce_op("nansum", lambda a, ax, kd: jnp.nansum(a, axis=ax, keepdims=kd))
+nanmean = reduce_op("nanmean", lambda a, ax, kd: jnp.nanmean(a, axis=ax, keepdims=kd))
+import jax.scipy.special as _jss
+
+logsumexp = reduce_op(
+    "logsumexp", lambda a, ax, kd: _jss.logsumexp(a, axis=ax, keepdims=kd)
+)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = coerce(x)
+    ddof = 1 if unbiased else 0
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply(lambda a: jnp.var(a, axis=ax, ddof=ddof, keepdims=keepdim), [x], name="var")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = coerce(x)
+    ddof = 1 if unbiased else 0
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply(lambda a: jnp.std(a, axis=ax, ddof=ddof, keepdims=keepdim), [x], name="std")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.median(a, axis=axis, keepdims=keepdim), [x], name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim), [x], name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    x = coerce(x)
+    return apply(
+        lambda a: jnp.quantile(a, jnp.asarray(q), axis=axis, keepdims=keepdim, method=interpolation),
+        [x],
+        name="quantile",
+    )
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    x = coerce(x)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply(lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim), [x])
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = coerce(x)
+    if axis is None:
+        return apply(lambda a: jnp.cumsum(a.reshape(-1)), [x], name="cumsum")
+    return apply(lambda a: jnp.cumsum(a, axis=axis), [x], name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = coerce(x)
+    if dim is None:
+        return apply(lambda a: jnp.cumprod(a.reshape(-1)), [x], name="cumprod")
+    return apply(lambda a: jnp.cumprod(a, axis=dim), [x], name="cumprod")
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = coerce(x)
+    ax = axis if axis is not None else 0
+    xx = x if axis is not None else x.reshape([-1])
+    vals = apply(lambda a: jnp.maximum.accumulate(a, axis=ax), [xx], name="cummax")
+    idx = apply(
+        lambda a: _cum_arg(a, ax, jnp.maximum), [xx.detach()], name="cummax_idx"
+    )
+    return vals, idx
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    x = coerce(x)
+    ax = axis if axis is not None else 0
+    xx = x if axis is not None else x.reshape([-1])
+    vals = apply(lambda a: jnp.minimum.accumulate(a, axis=ax), [xx], name="cummin")
+    idx = apply(lambda a: _cum_arg(a, ax, jnp.minimum), [xx.detach()], name="cummin_idx")
+    return vals, idx
+
+
+def _cum_arg(a, ax, op):
+    acc = op.accumulate(a, axis=ax)
+    eq = a == acc
+    n = a.shape[ax]
+    ar = jnp.arange(n).reshape([-1 if i == (ax % a.ndim) else 1 for i in range(a.ndim)])
+    idx = jnp.where(eq, ar, 0)
+    return jnp.maximum.accumulate(idx, axis=ax)

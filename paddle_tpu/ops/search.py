@@ -1,0 +1,121 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from .dispatch import apply, coerce
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = coerce(x)
+
+    def f(a):
+        if axis is None:
+            r = jnp.argmax(a.reshape(-1))
+            return r.reshape((1,) * a.ndim) if keepdim else r
+        return jnp.argmax(a, axis=axis, keepdims=keepdim)
+
+    return apply(f, [x], name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = coerce(x)
+
+    def f(a):
+        if axis is None:
+            r = jnp.argmin(a.reshape(-1))
+            return r.reshape((1,) * a.ndim) if keepdim else r
+        return jnp.argmin(a, axis=axis, keepdims=keepdim)
+
+    return apply(f, [x], name="argmin")
+
+
+def argsort(x, axis=-1, descending=False, stable=True, name=None):
+    x = coerce(x)
+
+    def f(a):
+        idx = jnp.argsort(a, axis=axis, stable=stable, descending=descending)
+        return idx
+
+    return apply(f, [x], name="argsort")
+
+
+def sort(x, axis=-1, descending=False, stable=True, name=None):
+    x = coerce(x)
+
+    def f(a):
+        s = jnp.sort(a, axis=axis, stable=stable, descending=descending)
+        return s
+
+    return apply(f, [x], name="sort")
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    x = coerce(x)
+    if isinstance(k, Tensor):
+        k = int(k.numpy())
+    ax = axis if axis is not None else -1
+
+    def f(a):
+        a2 = jnp.moveaxis(a, ax, -1)
+        if largest:
+            v, i = jax.lax.top_k(a2, k)
+        else:
+            v, i = jax.lax.top_k(-a2, k)
+            v = -v
+        return jnp.moveaxis(v, -1, ax), jnp.moveaxis(i, -1, ax)
+
+    vals, idx = apply(f, [x], multi=True, name="topk")
+    return vals, idx
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = coerce(x)
+
+    def f(a):
+        s = jnp.sort(a, axis=axis)
+        i = jnp.argsort(a, axis=axis)
+        v = jnp.take(s, k - 1, axis=axis)
+        ii = jnp.take(i, k - 1, axis=axis)
+        if keepdim:
+            v = jnp.expand_dims(v, axis)
+            ii = jnp.expand_dims(ii, axis)
+        return v, ii
+
+    return apply(f, [x], multi=True, name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = coerce(x)
+
+    def f(a):
+        s = jnp.sort(a, axis=axis)
+        s2 = jnp.moveaxis(s, axis, -1)
+        eq = s2[..., :, None] == s2[..., None, :]
+        cnt = eq.sum(-1)
+        best = jnp.argmax(cnt, -1)
+        v = jnp.take_along_axis(s2, best[..., None], -1)[..., 0]
+        idx = jnp.argmax(jnp.moveaxis(a, axis, -1) == v[..., None], -1)
+        if keepdim:
+            v = jnp.expand_dims(v, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return v, idx
+
+    return apply(f, [x], multi=True, name="mode")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    ss, v = coerce(sorted_sequence), coerce(values)
+    side = "right" if right else "left"
+    return apply(
+        lambda a, b: jnp.searchsorted(a, b, side=side).astype(jnp.int32 if out_int32 else jnp.int64),
+        [ss, v],
+        name="searchsorted",
+    )
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)

@@ -1,0 +1,377 @@
+"""paddle_tpu.Tensor — eager tensor over a JAX array.
+
+Re-designs the reference's eager tensor (paddle/fluid/eager/* AutogradMeta +
+pybind eager_method.cc — SURVEY.md §2.1) TPU-natively: the payload is an
+immutable jax.Array living in HBM; "in-place" ops rebind the payload (the
+step-compiler turns rebinding into buffer donation); autograd metadata is a
+(grad_node, out_index) pair into a Python tape whose vjp closures came from
+jax.vjp, so the same tape works eagerly op-by-op and under whole-step tracing.
+
+The `_data` / `grad` accessors are trace-aware: when a jit trace is active
+(paddle_tpu.jit.to_static), reads/writes are routed through the trace's state
+slots so captured module/optimizer/RNG state becomes explicit inputs/outputs
+of the compiled XLA program instead of baked constants.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import framework
+from .framework import core as _core
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+class Tensor:
+    __slots__ = (
+        "_raw",
+        "_grad_raw",
+        "stop_gradient",
+        "_grad_node",
+        "_out_index",
+        "persistable",
+        "name",
+        "_trainable",
+        "_hooks",
+        "__weakref__",
+    )
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient=True, name=None):
+        if isinstance(data, Tensor):
+            arr = data._data
+            if dtype is not None:
+                arr = arr.astype(_core.to_jax_dtype(dtype))
+        elif isinstance(data, (jnp.ndarray, jax.Array)) or _is_tracer(data):
+            arr = data if dtype is None else data.astype(_core.to_jax_dtype(dtype))
+        else:
+            npdata = np.asarray(data)
+            if dtype is not None:
+                npdata = npdata.astype(np.dtype(_core.convert_dtype(dtype)) if _core.convert_dtype(dtype) != "bfloat16" else jnp.bfloat16)
+            elif npdata.dtype == np.float64:
+                npdata = npdata.astype(np.float32)
+            elif npdata.dtype == np.int64:
+                npdata = npdata.astype(np.int64)  # keep int64 like paddle
+            arr = jnp.asarray(npdata)
+            if place is None:
+                place = framework._expected_place()
+        if place is not None and not _is_tracer(arr):
+            arr = jax.device_put(arr, place.jax_device())
+        self._raw = arr
+        self._grad_raw = None
+        self.stop_gradient = stop_gradient
+        self._grad_node = None
+        self._out_index = 0
+        self.persistable = False
+        self.name = name
+        self._trainable = True
+        self._hooks = None
+
+    # ------------------------------------------------------------------
+    # trace-aware payload access
+    # ------------------------------------------------------------------
+    @property
+    def _data(self):
+        tr = _core.active_trace()
+        if tr is not None:
+            return tr.read(self, "data")
+        return self._raw
+
+    @_data.setter
+    def _data(self, value):
+        tr = _core.active_trace()
+        if tr is not None:
+            tr.write(self, "data", value)
+        else:
+            self._raw = value
+
+    @property
+    def grad(self):
+        tr = _core.active_trace()
+        if tr is not None:
+            g = tr.read(self, "grad")
+        else:
+            g = self._grad_raw
+        if g is None:
+            return None
+        if isinstance(g, Tensor):
+            return g
+        t = Tensor.__new__(Tensor)
+        t._init_from_array(g, stop_gradient=True)
+        return t
+
+    @grad.setter
+    def grad(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        tr = _core.active_trace()
+        if tr is not None:
+            tr.write(self, "grad", value)
+        else:
+            self._grad_raw = value
+
+    def _init_from_array(self, arr, stop_gradient=True):
+        self._raw = arr
+        self._grad_raw = None
+        self.stop_gradient = stop_gradient
+        self._grad_node = None
+        self._out_index = 0
+        self.persistable = False
+        self.name = None
+        self._trainable = True
+        self._hooks = None
+        return self
+
+    # ------------------------------------------------------------------
+    # metadata
+    # ------------------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self):
+        return _core.convert_dtype(self._data.dtype)
+
+    @property
+    def place(self):
+        arr = self._raw
+        if _is_tracer(arr):
+            return framework._expected_place()
+        try:
+            dev = list(arr.devices())[0]
+        except Exception:
+            return framework._expected_place()
+        if dev.platform == "cpu":
+            return _core.CPUPlace(dev.id)
+        return _core.TPUPlace(dev.id)
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    def numel(self):
+        return self.size
+
+    def element_size(self):
+        return np.dtype(self._data.dtype).itemsize if self._data.dtype != jnp.bfloat16 else 2
+
+    # ------------------------------------------------------------------
+    # host interop
+    # ------------------------------------------------------------------
+    def numpy(self):
+        arr = self._data
+        if _is_tracer(arr):
+            raise RuntimeError(
+                "Tensor.numpy() is not allowed inside a @to_static traced function; "
+                "return the tensor instead or compute on device."
+            )
+        return np.asarray(arr)
+
+    def item(self, *args):
+        arr = self.numpy()
+        return arr.item(*args)
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __dlpack__(self, *a, **k):
+        return self._data.__dlpack__(*a, **k)
+
+    # ------------------------------------------------------------------
+    # autograd
+    # ------------------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from .autograd import backward as _backward
+
+        _backward([self], [grad_tensor] if grad_tensor is not None else None, retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self):
+        t = Tensor.__new__(Tensor)
+        t._init_from_array(self._data, stop_gradient=True)
+        t.name = self.name
+        return t
+
+    def detach_(self):
+        self.stop_gradient = True
+        self._grad_node = None
+        return self
+
+    def register_hook(self, hook):
+        if self._hooks is None:
+            self._hooks = []
+        self._hooks.append(hook)
+
+        class _Handle:
+            def __init__(self, owner, fn):
+                self._owner, self._fn = owner, fn
+
+            def remove(self):
+                try:
+                    self._owner._hooks.remove(self._fn)
+                except ValueError:
+                    pass
+
+        return _Handle(self, hook)
+
+    # ------------------------------------------------------------------
+    # conversion / movement
+    # ------------------------------------------------------------------
+    def astype(self, dtype):
+        from . import ops
+
+        return ops.cast(self, dtype)
+
+    cast = astype
+
+    def to(self, *args, **kwargs):
+        device = kwargs.get("device")
+        dtype = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, str):
+                if a in _core._STR2DTYPE or a in _core._ALIASES:
+                    dtype = a
+                else:
+                    device = a
+            elif isinstance(a, _core.Place):
+                device = a
+        out = self
+        if dtype is not None:
+            out = out.astype(dtype)
+        if device is not None:
+            if isinstance(device, _core.Place):
+                place = device
+            else:
+                dev = str(device).lower()
+                kind, _, idx = dev.partition(":")
+                idx = int(idx) if idx else 0
+                place = _core.CPUPlace(idx) if kind == "cpu" else _core.TPUPlace(idx)
+            arr = out._data
+            if not _is_tracer(arr):
+                arr = jax.device_put(arr, place.jax_device())
+            t = Tensor.__new__(Tensor)
+            t._init_from_array(arr, stop_gradient=out.stop_gradient)
+            out = t
+        return out
+
+    def cpu(self):
+        return self.to("cpu")
+
+    def tpu(self, idx=0):
+        return self.to(f"tpu:{idx}")
+
+    cuda = tpu
+
+    def pin_memory(self):
+        return self
+
+    def clone(self):
+        from . import ops
+
+        return ops.assign(self)
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    # ------------------------------------------------------------------
+    # printing
+    # ------------------------------------------------------------------
+    def __repr__(self):
+        arr = self._raw
+        if _is_tracer(arr):
+            return f"Tensor(traced, shape={list(arr.shape)}, dtype={self.dtype})"
+        body = np.array2string(np.asarray(arr), precision=6, separator=", ")
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype}, place={self.place}, "
+            f"stop_gradient={self.stop_gradient},\n       {body})"
+        )
+
+    __str__ = __repr__
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self.shape[0]
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(self.shape[0]):
+            yield self[i]
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return str(self)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: paddle.base.framework.Parameter)."""
+
+    __slots__ = ("optimize_attr", "regularizer", "is_distributed", "need_clip")
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self._trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+        self.need_clip = True
+
+    @property
+    def trainable(self):
+        return self._trainable
+
+    @trainable.setter
+    def trainable(self, v):
+        self._trainable = bool(v)
+        self.stop_gradient = not v
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor."""
+    if isinstance(data, Tensor) and dtype is None and place is None:
+        t = data.detach()
+        t.stop_gradient = stop_gradient
+        return t
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)

@@ -1,1 +1,229 @@
-# placeholder during bring-up
+"""AMP (reference: python/paddle/amp/ auto_cast.py + grad_scaler.py).
+
+TPU-native: bfloat16 is the default AMP dtype (no loss scaling needed —
+GradScaler degrades to a pass-through when scaling is unnecessary, matching
+the reference's bf16 behavior); fp16+dynamic loss scaling kept for parity.
+O1 casting happens inside the op dispatcher via per-op white/black lists
+(ops/dispatch.py amp_cast_inputs — the analogue of the reference's
+AmpAutoCasts in eager codegen).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from .. import ops
+from ..framework import core as _core
+from ..nn.layer import Layer
+from ..ops.dispatch import apply, coerce
+from ..tensor import Tensor
+
+WHITE_LIST = {"matmul", "linear", "conv1d", "conv2d", "conv3d", "conv2d_transpose", "bmm", "mm", "einsum", "flash_attention"}
+BLACK_LIST = {"softmax", "log_softmax", "layer_norm", "batch_norm", "cross_entropy", "nll_loss", "mean", "sum", "exp", "log", "pow"}
+
+
+class AmpState:
+    def __init__(self, enabled, dtype, level, custom_white_list=None, custom_black_list=None):
+        self.enabled = enabled
+        self.dtype = dtype
+        self.level = level
+        self.white = set(WHITE_LIST) | set(custom_white_list or ())
+        self.black = set(BLACK_LIST) | set(custom_black_list or ())
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1", dtype="bfloat16", use_promote=True):
+    if level not in ("O0", "O1", "O2"):
+        raise ValueError(f"amp level must be O0/O1/O2, got {level}")
+    state = AmpState(enable and level != "O0", dtype, level, custom_white_list, custom_black_list)
+    old = _core.set_active_amp(state if state.enabled else None)
+    try:
+        yield
+    finally:
+        _core.set_active_amp(old)
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weight=None, save_dtype=None):
+    """O2: cast matmul-heavy params to the AMP dtype, keep norms fp32
+    (reference: paddle.amp.decorate pure-fp16 with master weights)."""
+    single_model = isinstance(models, Layer)
+    model_list = [models] if single_model else list(models)
+    if level == "O1":
+        return (models, optimizers) if optimizers is not None else models
+    target = _core.to_jax_dtype(dtype)
+
+    from ..nn.norm import _BatchNormBase, GroupNorm, LayerNorm, RMSNorm
+
+    keep_fp32 = (_BatchNormBase, GroupNorm, LayerNorm, RMSNorm)
+
+    for model in model_list:
+        for layer in model.sublayers(include_self=True):
+            if isinstance(layer, keep_fp32):
+                continue
+            for pname, p in layer._parameters.items():
+                if p is not None and p.dtype == "float32":
+                    p._data = p._data.astype(target)
+
+    if optimizers is not None:
+        opts = [optimizers] if not isinstance(optimizers, (list, tuple)) else list(optimizers)
+        for opt in opts:
+            use_master = master_weight is None or master_weight
+            if use_master:
+                opt._multi_precision = True
+                for p in opt._all_params():
+                    if p.dtype in ("float16", "bfloat16") and id(p) not in opt._master_weights:
+                        opt._master_weights[id(p)] = Tensor(
+                            p._data.astype(jnp.float32), stop_gradient=True
+                        )
+        return (models, optimizers)
+    return models
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: python/paddle/amp/grad_scaler.py)."""
+
+    def __init__(
+        self,
+        enable=True,
+        init_loss_scaling=2.0**15,
+        incr_ratio=2.0,
+        decr_ratio=0.5,
+        incr_every_n_steps=2000,
+        decr_every_n_nan_or_inf=1,
+        use_dynamic_loss_scaling=True,
+    ):
+        self._enable = enable
+        self._scale = Tensor(jnp.asarray(init_loss_scaling, jnp.float32))
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale._data = jnp.asarray(v, jnp.float32)
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return apply(lambda a, s: a * s.astype(a.dtype), [coerce(var), self._scale], name="scale_loss")
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        pgs = optimizer._params_grads
+        if not pgs:
+            return
+        grads = [g for _, g in pgs]
+        inv = apply(lambda s: 1.0 / s, [self._scale])
+        finite_flags = []
+        for (p, g) in pgs:
+            new_g = apply(
+                lambda a, iv: a * iv.astype(a.dtype), [coerce(g), inv], name="unscale"
+            )
+            p.grad = new_g
+            finite_flags.append(
+                apply(lambda a: jnp.all(jnp.isfinite(a.astype(jnp.float32))), [new_g.detach()])
+            )
+        all_finite = finite_flags[0]
+        for fl in finite_flags[1:]:
+            all_finite = apply(lambda a, b: jnp.logical_and(a, b), [all_finite, fl])
+        self._found_inf = not bool(all_finite.numpy()) if not _is_tracing() else all_finite
+        return
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if not isinstance(self._found_inf, (bool,)) and self._found_inf is not None and not isinstance(self._found_inf, Tensor):
+            pass
+        if self._found_inf is False or self._found_inf is None:
+            # unscale_ not called yet
+            self.unscale_(optimizer)
+        if isinstance(self._found_inf, Tensor):
+            raise RuntimeError(
+                "GradScaler with dynamic host-side skipping is not supported inside "
+                "@to_static; use bf16 AMP (no scaler) for compiled steps."
+            )
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        if not self._enable or not self._dynamic:
+            self._found_inf = None
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale._data = self._scale._data * self._decr_ratio
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale._data = self._scale._data * self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = None
+
+    def state_dict(self):
+        return {
+            "scale": self._scale.numpy(),
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every,
+            "decr_every_n_nan_or_inf": self._decr_every,
+            "good_steps": self._good_steps,
+            "bad_steps": self._bad_steps,
+        }
+
+    def load_state_dict(self, state):
+        import numpy as np
+
+        self._scale._data = jnp.asarray(np.asarray(state["scale"]), jnp.float32)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+
+def _is_tracing():
+    return _core.active_trace() is not None
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+class debugging:
+    @staticmethod
+    def enable_operator_stats_collection():
+        pass
+
+    @staticmethod
+    def disable_operator_stats_collection():
+        pass

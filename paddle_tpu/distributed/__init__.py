@@ -1,1 +1,59 @@
-# placeholder during bring-up
+"""paddle_tpu.distributed (reference surface: python/paddle/distributed/)."""
+
+from .env import (  # noqa: F401
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+)
+from .collective import (  # noqa: F401
+    Group,
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    alltoall,
+    alltoall_single,
+    barrier,
+    broadcast,
+    broadcast_object_list,
+    get_group,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    stream,
+)
+from . import mesh  # noqa: F401
+from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    DistAttr,
+    Partial,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    reshard,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+)
+from .fleet.meta_parallel.parallel_wrappers import DataParallel  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Single-controller JAX sees all local chips in one process; spawn runs
+    func once (the reference forks one process per GPU)."""
+    func(*args)
+    return None
+
+
+def launch():
+    from .launch.main import main
+
+    main()

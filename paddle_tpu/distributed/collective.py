@@ -1,0 +1,300 @@
+"""Collective communication API (reference: ProcessGroup/ProcessGroupNCCL,
+paddle/fluid/distributed/collective/ + python/paddle/distributed/communication/
+— SURVEY.md §2.2/§5.8).
+
+TPU-native `ProcessGroupXLA` stance: a "group" is a set of mesh axes.  Inside
+compiled/shard_map regions the collectives lower to XLA collectives over ICI
+(psum / all_gather / reduce_scatter / all_to_all / ppermute); eagerly on
+sharded arrays the same semantics are obtained by resharding (XLA inserts the
+transfers).  Async Task handles exist for API parity — XLA's async dispatch
+already overlaps communication, so wait() is a sync point.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ops.dispatch import apply, coerce, wrap, inplace_rebind
+from ..tensor import Tensor
+from . import mesh as _mesh
+from .env import get_rank, get_world_size
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Task:
+    """Async task handle (reference: ProcessGroup::Task)."""
+
+    def __init__(self, tensors=None):
+        self._tensors = tensors or []
+
+    def wait(self):
+        for t in self._tensors:
+            arr = t._raw if isinstance(t, Tensor) else t
+            if not isinstance(arr, jax.core.Tracer):
+                jax.block_until_ready(arr)
+        return True
+
+    def is_completed(self):
+        return True
+
+
+class Group:
+    """A communicator = mesh axis (or explicit device list).
+
+    The reference creates an NCCL comm per group; here the axis name carries
+    the same information into XLA collective lowering.
+    """
+
+    _next_id = 0
+
+    def __init__(self, axis_name=None, ranks=None, pg=None):
+        self.axis_name = axis_name
+        self.ranks = ranks
+        Group._next_id += 1
+        self.id = Group._next_id
+
+    @property
+    def nranks(self):
+        if self.axis_name is not None:
+            return _mesh.axis_size(self.axis_name)
+        if self.ranks is not None:
+            return len(self.ranks)
+        return max(get_world_size(), 1)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def rank(self):
+        return self.get_group_rank(get_rank())
+
+    def get_group_rank(self, global_rank):
+        if self.ranks is not None:
+            try:
+                return self.ranks.index(global_rank)
+            except ValueError:
+                return -1
+        return global_rank % self.nranks
+
+    @property
+    def process_group(self):
+        return self
+
+
+_default_group = None
+
+
+def _get_group(group):
+    global _default_group
+    if group is not None:
+        return group
+    if _default_group is None:
+        _default_group = Group()
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    return Group(axis_name=axis_name, ranks=ranks)
+
+
+def get_group(gid=0):
+    return _get_group(None)
+
+
+def _axis_in_trace(group):
+    """Axis name usable for lax collectives (inside shard_map)."""
+    g = _get_group(group)
+    return g.axis_name
+
+
+def _in_named_trace(axis):
+    if axis is None:
+        return False
+    try:
+        jax.lax.axis_index(axis)
+        return True
+    except (NameError, Exception):
+        return False
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = _get_group(group)
+    axis = g.axis_name
+
+    def f(a):
+        if axis is not None and _in_named_trace(axis):
+            if op == ReduceOp.SUM:
+                return jax.lax.psum(a, axis)
+            if op == ReduceOp.MAX:
+                return jax.lax.pmax(a, axis)
+            if op == ReduceOp.MIN:
+                return jax.lax.pmin(a, axis)
+            if op == ReduceOp.AVG:
+                return jax.lax.pmean(a, axis)
+            raise ValueError(op)
+        # eager / GSPMD: data parallel arrays are sharded on a batch axis —
+        # a replicated constraint makes XLA insert the reduction; a fully
+        # replicated array is already "reduced" across the group
+        return a
+
+    out = apply(f, [coerce(tensor)], name="all_reduce")
+    inplace_rebind(tensor, out)
+    return Task([tensor]) if not sync_op else Task([tensor])
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    g = _get_group(group)
+    aname = g.axis_name
+    t = coerce(tensor)
+    n = g.nranks
+
+    if aname is not None and _in_named_trace(aname):
+        out = apply(
+            lambda a: jax.lax.all_gather(a, aname, axis=0), [t], name="all_gather"
+        )
+        parts = [out[i] for i in range(n)]
+    else:
+        parts = [t.clone() for _ in range(n)]
+    if tensor_list is not None:
+        tensor_list.clear()
+        tensor_list.extend(parts)
+    return Task(parts)
+
+
+def all_gather_object(object_list, obj, group=None):
+    n = _get_group(group).nranks
+    object_list.clear()
+    object_list.extend([obj] * n)
+
+
+def reduce_scatter(tensor, tensor_list_or_tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = _get_group(group)
+    aname = g.axis_name
+    if isinstance(tensor_list_or_tensor, (list, tuple)):
+        from ..ops.manipulation import concat
+
+        src = concat(list(tensor_list_or_tensor), axis=0)
+    else:
+        src = coerce(tensor_list_or_tensor)
+
+    if aname is not None and _in_named_trace(aname):
+        out = apply(
+            lambda a: jax.lax.psum_scatter(a, aname, scatter_dimension=0, tiled=True),
+            [src],
+            name="reduce_scatter",
+        )
+    else:
+        n = g.nranks
+        r = g.rank if g.rank >= 0 else 0
+        size = src.shape[0] // max(n, 1)
+        out = src[r * size : (r + 1) * size]
+    inplace_rebind(tensor, out)
+    return Task([tensor])
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    # single-controller: arrays are already consistent; in shard_map use ppermute
+    return Task([tensor])
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = _get_group(group)
+    if tensor_list:
+        r = g.rank if g.rank >= 0 else 0
+        inplace_rebind(tensor, coerce(tensor_list[min(r, len(tensor_list) - 1)]))
+    return Task([tensor])
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    g = _get_group(group)
+    aname = g.axis_name
+    from ..ops.manipulation import concat, split
+
+    stacked = concat([coerce(t).unsqueeze(0) for t in in_tensor_list], axis=0)
+    if aname is not None and _in_named_trace(aname):
+        out = apply(
+            lambda a: jax.lax.all_to_all(a, aname, split_axis=0, concat_axis=0),
+            [stacked],
+            name="alltoall",
+        )
+        parts = [out[i] for i in range(len(in_tensor_list))]
+    else:
+        parts = [coerce(t) for t in in_tensor_list]
+    out_tensor_list.clear()
+    out_tensor_list.extend(parts)
+    return Task(parts)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=None, group=None, sync_op=True):
+    g = _get_group(group)
+    aname = g.axis_name
+    t = coerce(in_tensor)
+    if aname is not None and _in_named_trace(aname):
+        out = apply(
+            lambda a: jax.lax.all_to_all(
+                a.reshape((g.nranks, -1) + a.shape[1:]), aname, 0, 0
+            ).reshape(a.shape),
+            [t],
+            name="alltoall_single",
+        )
+    else:
+        out = t
+    inplace_rebind(out_tensor, out)
+    return Task([out_tensor])
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv are expressed as ppermute inside compiled "
+        "pipeline schedules (see distributed.fleet.meta_parallel); eager p2p "
+        "between single-controller devices is not meaningful"
+    )
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "see distributed.collective.send"
+    )
+
+
+def barrier(group=None):
+    jax.block_until_ready(jnp.zeros(()))
+    return Task()
+
+
+def stream_allreduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True, use_calc_stream=False):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+class stream:
+    """paddle.distributed.stream.* namespace (API parity)."""
+
+    all_reduce = staticmethod(stream_allreduce)
+
+    @staticmethod
+    def all_gather(tensor_or_list, tensor, group=None, sync_op=True, use_calc_stream=False):
+        return all_gather(tensor_or_list, tensor, group, sync_op)

@@ -1,0 +1,103 @@
+"""Distributed environment (reference: paddle.distributed.parallel
+init_parallel_env + ParallelEnv over TCPStore rendezvous — SURVEY.md §2.2).
+
+TPU-native: a single-controller JAX process sees all local chips; multi-host
+uses jax.distributed (coordination service — the analogue of the reference's
+TCPStore bootstrap).  Rank/world size come from the launch CLI env contract
+(PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM) when present, else from JAX.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+_initialized = False
+
+
+def _env_int(name, default):
+    v = os.environ.get(name)
+    return int(v) if v not in (None, "") else default
+
+
+def init_parallel_env():
+    """Bootstraps multi-host JAX if the launch env asks for it."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    n_hosts = _env_int("PADDLE_TRAINERS_NUM", 1)
+    host_id = _env_int("PADDLE_TRAINER_ID", 0)
+    coord = os.environ.get("PADDLE_MASTER", os.environ.get("MASTER_ADDR"))
+    if n_hosts > 1 and coord:
+        jax.distributed.initialize(
+            coordinator_address=coord, num_processes=n_hosts, process_id=host_id
+        )
+    _initialized = True
+    return ParallelEnv()
+
+
+def is_initialized():
+    return _initialized
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.get_group_rank(global_rank())
+    return global_rank()
+
+
+def global_rank():
+    # data-parallel rank in the launch contract; single-controller covers all
+    # local devices so the "rank" is the process index
+    return _env_int("PADDLE_TRAINER_ID", jax.process_index() if _initialized else 0)
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    n = _env_int("PADDLE_TRAINERS_NUM", 0)
+    if n:
+        return n
+    try:
+        return jax.process_count()
+    except RuntimeError:
+        return 1
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def local_rank(self):
+        return _env_int("PADDLE_LOCAL_RANK", 0)
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def dev_id(self):
+        return self.local_rank
+
+    @property
+    def device_type(self):
+        return "tpu" if jax.devices()[0].platform != "cpu" else "cpu"
+
+    @property
+    def current_endpoint(self):
+        eps = self.trainer_endpoints
+        r = min(self.rank, len(eps) - 1) if eps else 0
+        return eps[r] if eps else "127.0.0.1:6170"
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []

@@ -1,0 +1,150 @@
+"""Fleet facade (reference: python/paddle/distributed/fleet/fleet.py).
+
+`fleet.init(is_collective=True, strategy)` builds the hybrid mesh from
+strategy.hybrid_configs; `distributed_model` / `distributed_optimizer` wrap
+model/optimizer for the configured parallelisms — mapped onto GSPMD +
+sharding constraints rather than NCCL groups (SURVEY.md §2.2)."""
+
+from __future__ import annotations
+
+from .. import mesh as _mesh
+from ..env import get_rank, get_world_size, init_parallel_env
+from .strategy import DistributedStrategy
+from .topology import (
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+from . import meta_parallel  # noqa: F401
+from .meta_parallel import (  # noqa: F401
+    ColumnParallelLinear,
+    DataParallel,
+    LayerDesc,
+    PipelineLayer,
+    PipelineParallel,
+    RowParallelLinear,
+    SharedLayerDesc,
+    ShardingParallel,
+    TensorParallel,
+    VocabParallelEmbedding,
+    get_rng_state_tracker,
+)
+
+_fleet_state = {"initialized": False, "strategy": None, "hcg": None}
+
+
+class _RoleMaker:
+    def _is_collective(self):
+        return True
+
+
+class UserDefinedRoleMaker(_RoleMaker):
+    def __init__(self, **kwargs):
+        pass
+
+
+class PaddleCloudRoleMaker(_RoleMaker):
+    def __init__(self, is_collective=False, **kwargs):
+        self._collective = is_collective
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
+    init_parallel_env()
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    hcg = HybridCommunicateGroup(
+        dp_degree=hc.get("dp_degree", 1),
+        mp_degree=hc.get("mp_degree", 1),
+        pp_degree=hc.get("pp_degree", 1),
+        sharding_degree=hc.get("sharding_degree", 1),
+        sep_degree=hc.get("sep_degree", 1),
+    )
+    set_hybrid_communicate_group(hcg)
+    _fleet_state.update(initialized=True, strategy=strategy, hcg=hcg)
+    return None
+
+
+def is_first_worker():
+    return get_rank() == 0
+
+
+def worker_index():
+    return get_rank()
+
+
+def worker_num():
+    return get_world_size()
+
+
+def get_hybrid_communicate_group_():
+    return get_hybrid_communicate_group()
+
+
+def distributed_model(model):
+    """Wrap for the active parallelisms (reference: fleet.distributed_model)."""
+    hcg = get_hybrid_communicate_group()
+    strategy = _fleet_state.get("strategy")
+    if isinstance(model, PipelineLayer):
+        return PipelineParallel(model, hcg, strategy)
+    if hcg.get_model_parallel_world_size() > 1:
+        return TensorParallel(model)
+    if hcg.get_data_parallel_world_size() > 1:
+        return DataParallel(model)
+    return model
+
+
+class _DistributedOptimizer:
+    """Optimizer wrapper; ZeRO sharding of optimizer state over the
+    'sharding' axis happens lazily at first step (reference:
+    DygraphShardingOptimizer)."""
+
+    def __init__(self, optimizer, strategy=None):
+        self._inner = optimizer
+        self._strategy = strategy
+        self._sharded = False
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def _maybe_shard_states(self):
+        if self._sharded:
+            return
+        self._sharded = True
+        if _mesh.axis_size("sharding") <= 1:
+            return
+        from jax.sharding import PartitionSpec as P
+
+        for key, acc in self._inner._accumulators.items():
+            if acc._raw.ndim >= 1 and acc._raw.shape and acc._raw.shape[0] % _mesh.axis_size("sharding") == 0:
+                _mesh.shard_tensor_(acc, P("sharding"))
+
+    def step(self):
+        self._inner.step()
+        self._maybe_shard_states()
+
+    def clear_grad(self, *a, **k):
+        self._inner.clear_grad()
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        return None, None
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return _DistributedOptimizer(optimizer, strategy or _fleet_state.get("strategy"))
+
+
+class utils:
+    @staticmethod
+    def recompute(function, *args, **kwargs):
+        from ...incubate.recompute import recompute as _rc
+
+        return _rc(function, *args, **kwargs)
+
+
+# sub-namespace parity: fleet.base.topology etc.
+class base:
+    from . import topology as topology  # noqa
+    from .strategy import DistributedStrategy as DistributedStrategy  # noqa

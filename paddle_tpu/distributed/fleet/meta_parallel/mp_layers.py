@@ -1,0 +1,132 @@
+"""Tensor-parallel layers (reference:
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/mp_layers.py:
+ColumnParallelLinear / RowParallelLinear / VocabParallelEmbedding /
+ParallelCrossEntropy — SURVEY.md §2.2 "TP").
+
+TPU-native design: weights carry NamedShardings on the 'mp' mesh axis and
+activations get sharding constraints; **GSPMD inserts the identity/allreduce
+pairs** that the reference implements by hand with NCCL (mp_ops.py
+_c_identity/_mp_allreduce).  The layer API (gather_output,
+input_is_parallel) is preserved so fleet model code ports unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ....nn import functional as F
+from ....nn import initializer as I
+from ....nn.layer import Layer
+from ....ops.dispatch import apply, coerce
+from ... import mesh as _mesh
+from ..topology import get_hybrid_communicate_group
+
+
+class ColumnParallelLinear(Layer):
+    """Weight [in, out] sharded on out ('mp'); output column-sharded unless
+    gather_output."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=None, gather_output=True, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.world_size = _mesh.axis_size("mp")
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr, default_initializer=I.XavierNormal()
+        )
+        self.weight.is_distributed = True
+        _mesh.shard_tensor_(self.weight, P(None, "mp"))
+        if has_bias is None or has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.is_distributed = True
+            _mesh.shard_tensor_(self.bias, P("mp"))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        spec = (None,) * (len(out.shape) - 1)
+        if self.gather_output:
+            out = apply(lambda a: _mesh.constraint(a, P(*spec, None)), [out], name="mp_gather")
+        else:
+            out = apply(lambda a: _mesh.constraint(a, P(*spec, "mp")), [out], name="mp_shard")
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Weight [in, out] sharded on in ('mp'); partial outputs summed by GSPMD
+    when the replicated constraint is applied (the reference's allreduce)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True, input_is_parallel=False, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.world_size = _mesh.axis_size("mp")
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr, default_initializer=I.XavierNormal()
+        )
+        self.weight.is_distributed = True
+        _mesh.shard_tensor_(self.weight, P("mp", None))
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        x = coerce(x)
+        if not self.input_is_parallel:
+            spec = (None,) * (len(x.shape) - 1)
+            x = apply(lambda a: _mesh.constraint(a, P(*spec, "mp")), [x], name="mp_scatter")
+        out = F.linear(x, self.weight, None)
+        spec = (None,) * (len(out.shape) - 1)
+        out = apply(lambda a: _mesh.constraint(a, P(*spec, None)), [out], name="mp_reduce")
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding table sharded on the vocab dim over 'mp'."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None, mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.world_size = _mesh.axis_size("mp")
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim],
+            attr=weight_attr,
+            default_initializer=I.XavierNormal(),
+        )
+        self.weight.is_distributed = True
+        _mesh.shard_tensor_(self.weight, P("mp", None))
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        spec = (None,) * (len(out.shape) - 1)
+        return apply(lambda a: _mesh.constraint(a, P(*spec, None)), [out], name="vocab_gather")
+
+
+class ParallelCrossEntropy(Layer):
+    """Cross entropy over class-sharded logits (reference:
+    mp_ops._c_softmax_with_cross_entropy).  GSPMD partitions the logsumexp."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        loss = F.cross_entropy(
+            input, label, reduction="none", ignore_index=self.ignore_index
+        )
+        from ....ops.manipulation import unsqueeze
+
+        return unsqueeze(loss, -1)
+
+
+class ParallelColumnLinear(ColumnParallelLinear):
+    pass

@@ -1,0 +1,91 @@
+"""Model wrappers for each parallelism (reference:
+python/paddle/distributed/fleet/meta_parallel/{data_parallel,*}.py +
+paddle.DataParallel in python/paddle/fluid/dygraph/parallel.py).
+
+TPU-native DP: inputs arrive batch-sharded over the 'dp' mesh axis
+(DistributedBatchSampler → device_put with P('dp', ...)); gradients come out
+correctly reduced because the loss reduction spans the global batch under
+GSPMD — no Reducer/bucketing machinery is needed (the reference's
+reducer.cc exists to overlap NCCL with backward; XLA's latency-hiding
+scheduler owns that here)."""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ....nn.layer import Layer
+from ....ops.dispatch import apply, coerce
+from ....tensor import Tensor
+from ... import mesh as _mesh
+
+
+class _Wrapper(Layer):
+    def __init__(self, layers):
+        super().__init__()
+        self._layers = layers
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    @property
+    def parameters_(self):
+        return self._layers.parameters()
+
+
+class DataParallel(_Wrapper):
+    """paddle.DataParallel — shards incoming batches over the 'dp' axis."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25, last_comm_buffer_size=1, find_unused_parameters=False, group=None):
+        super().__init__(layers)
+        if _mesh.get_mesh() is None and len(jax.devices()) > 1:
+            _mesh.build_mesh(dp=-1)
+
+    def _shard_input(self, t):
+        if not isinstance(t, Tensor) or _mesh.get_mesh() is None:
+            return t
+        nd = len(t.shape)
+        spec = P("dp", *([None] * (nd - 1)))
+        sh = _mesh.sharding_for(spec)
+        if sh is not None and not isinstance(t._raw, jax.core.Tracer):
+            t = Tensor(jax.device_put(t._raw, sh), stop_gradient=t.stop_gradient)
+        return t
+
+    def forward(self, *args, **kwargs):
+        args = tuple(self._shard_input(a) for a in args)
+        kwargs = {k: self._shard_input(v) for k, v in kwargs.items()}
+        return self._layers(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    @staticmethod
+    def no_sync():
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            yield
+
+        return _ctx()
+
+
+class TensorParallel(_Wrapper):
+    """Weights already carry 'mp' shardings from the mp layers."""
+
+
+class ShardingParallel(_Wrapper):
+    pass
+
+
+class SegmentParallel(_Wrapper):
+    pass

@@ -1,0 +1,75 @@
+"""PipelineParallel runtime (reference:
+python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:
+train_batch with 1F1B / interleaved schedules over NCCL p2p).
+
+Round-1 TPU-native execution: `train_batch` runs the microbatch loop with
+gradient accumulation; each microbatch's fwd+bwd executes in the current
+(optionally step-compiled) program, and stage weights may be 'pp'-sharded so
+XLA overlaps cross-stage transfer with compute.  The explicit
+ppermute-per-stage 1F1B schedule is the M6 milestone (SURVEY.md §7)."""
+
+from __future__ import annotations
+
+from ....nn.layer import Layer
+from ....ops.manipulation import split as _split
+from ..topology import get_hybrid_communicate_group
+from .pp_layers import PipelineLayer
+
+
+class PipelineParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel expects a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg or get_hybrid_communicate_group()
+        self._strategy = strategy
+        acc = 1
+        micro = 1
+        if strategy is not None:
+            cfg = getattr(strategy, "pipeline_configs", None)
+            if cfg:
+                acc = cfg.get("accumulate_steps", 1)
+                micro = cfg.get("micro_batch_size", 1)
+        self.accumulate_steps = acc
+        self.micro_batch_size = micro
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        x, y = data
+        n_micro = self.accumulate_steps
+        bsz = x.shape[0]
+        if n_micro > 1 and bsz % n_micro == 0:
+            xs = _split(x, n_micro, axis=0)
+            ys = _split(y, n_micro, axis=0)
+        else:
+            xs, ys = [x], [y]
+            n_micro = 1
+
+        total = None
+        for xi, yi in zip(xs, ys):
+            out = self._layers(xi)
+            loss = self._layers.loss(out, yi)
+            loss = loss / n_micro
+            if scaler is not None:
+                scaler.scale(loss).backward()
+            else:
+                loss.backward()
+            total = loss if total is None else total + loss
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return total.detach()
+
+    def eval_batch(self, data, compute_loss=True):
+        x, y = data
+        out = self._layers(x)
+        if compute_loss:
+            return self._layers.loss(out, y)
+        return out

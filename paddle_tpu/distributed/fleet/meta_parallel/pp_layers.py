@@ -1,0 +1,106 @@
+"""Pipeline-parallel layers (reference:
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/pp_layers.py:
+LayerDesc / SharedLayerDesc / PipelineLayer — SURVEY.md §2.2 "PP").
+
+Round-1 TPU-native execution model: the stage partition (LayerDesc list →
+segments) is preserved; microbatched execution with gradient accumulation
+runs inside ONE compiled program, and stage weights can be sharded over the
+'pp' mesh axis.  A ppermute-based 1F1B schedule over per-stage programs is
+the planned optimization (SURVEY.md §7 M6) — the user API is already final.
+"""
+
+from __future__ import annotations
+
+import math
+
+from ....nn.layer import Layer
+from ....nn.container import LayerList
+from ..topology import get_hybrid_communicate_group
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    def __init__(
+        self,
+        layers,
+        num_stages=None,
+        topology=None,
+        loss_fn=None,
+        seg_method="uniform",
+        recompute_interval=0,
+        recompute_ctx=None,
+        num_virtual_pipeline_stages=None,
+    ):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._topo = topology
+        hcg = get_hybrid_communicate_group()
+        self._num_stages = num_stages or hcg.get_pipe_parallel_world_size()
+        self._recompute_interval = recompute_interval
+
+        self._layers_desc = list(layers)
+        self._shared_layers = {}
+        built = []
+        for d in self._layers_desc:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared_layers:
+                    self._shared_layers[d.layer_name] = d.build_layer()
+                built.append((self._shared_layers[d.layer_name], d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append((d.build_layer(), None))
+            elif isinstance(d, Layer):
+                built.append((d, None))
+            elif callable(d):
+                built.append((d, None))
+            else:
+                raise TypeError(f"Unsupported pipeline layer desc {d!r}")
+        self.run_function = built
+        self._sublist = LayerList([l for l, _ in built if isinstance(l, Layer)])
+
+        # stage segmentation (kept for introspection/parity)
+        n = len(built)
+        per = max(1, math.ceil(n / self._num_stages))
+        self._segments = [
+            (i * per, min((i + 1) * per, n)) for i in range(self._num_stages)
+        ]
+
+    def get_stage_from_index(self, index):
+        for sid, (lo, hi) in enumerate(self._segments):
+            if lo <= index < hi:
+                return sid
+        return self._num_stages - 1
+
+    def forward(self, x):
+        for layer, fwd in self.run_function:
+            if fwd is not None:
+                x = fwd(layer, x)
+            elif isinstance(layer, Layer) or callable(layer):
+                x = layer(x)
+        return x
+
+    def loss(self, output, label):
+        if self._loss_fn is None:
+            raise RuntimeError("PipelineLayer built without loss_fn")
+        return self._loss_fn(output, label)

@@ -1,0 +1,67 @@
+"""Model-parallel RNG control (reference:
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py
+RNGStatesTracker).  TPU-native: tracked states are separate Generators whose
+keys fold in the axis index, so per-axis-distinct dropout patterns compose
+with step compilation (keys are threaded state, never baked)."""
+
+from __future__ import annotations
+
+import contextlib
+
+from ....framework.random import Generator, default_generator
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = Generator(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = states
+
+    @contextlib.contextmanager
+    def rng_state(self, name="model_parallel_rng"):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        import paddle_tpu.framework.random as R
+
+        saved = R.default_generator
+        R.default_generator = self.states_[name]
+        try:
+            yield
+        finally:
+            R.default_generator = saved
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker
+
+
+def model_parallel_random_seed(seed=None):
+    import random as _pyrandom
+
+    from ....framework.random import seed as _seed
+
+    base = seed if seed is not None else _pyrandom.randint(0, 2**31 - 1)
+    _tracker.reset()
+    _tracker.add("model_parallel_rng", base + 1)
+    _tracker.add("local_seed", base + 2)
+    _seed(base)

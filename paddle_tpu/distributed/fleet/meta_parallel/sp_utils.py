@@ -1,0 +1,79 @@
+"""Megatron-style sequence parallel utils (reference:
+python/paddle/distributed/fleet/utils/sequence_parallel_utils.py:
+ScatterOp / GatherOp / AllGatherOp / ReduceScatterOp +
+mark_as_sequence_parallel_parameter — SURVEY.md §2.2 "SP").
+
+TPU-native: the scatter/gather pairs become sequence-dim sharding
+constraints on the 'mp' axis; GSPMD places the all-gather/reduce-scatter
+pair at region boundaries (the hand-inserted collectives of the reference).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ....ops.dispatch import apply, coerce
+from ... import mesh as _mesh
+
+
+def _seq_axis_constraint(x, shard):
+    """x: [B, S, H] (batch-first). shard=True → S sharded over mp."""
+    x = coerce(x)
+    nd = len(x.shape)
+    if nd < 2:
+        return x
+    spec = [None] * nd
+    if shard:
+        spec[1] = "mp"
+
+    return apply(lambda a: _mesh.constraint(a, P(*spec)), [x], name="sp_constraint")
+
+
+class ScatterOp:
+    @staticmethod
+    def apply(x):
+        return _seq_axis_constraint(x, shard=True)
+
+
+class GatherOp:
+    @staticmethod
+    def apply(x):
+        return _seq_axis_constraint(x, shard=False)
+
+
+class AllGatherOp:
+    @staticmethod
+    def apply(x):
+        return _seq_axis_constraint(x, shard=False)
+
+
+class ReduceScatterOp:
+    @staticmethod
+    def apply(x):
+        return _seq_axis_constraint(x, shard=True)
+
+
+def scatter(x):
+    return ScatterOp.apply(x)
+
+
+def all_gather(x):
+    return AllGatherOp.apply(x)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.sequence_parallel = True
+
+
+def is_sequence_parallel_parameter(parameter):
+    return getattr(parameter, "sequence_parallel", False)
+
+
+def create_fused_allreduce_gradient_hooks(model, accumulation_steps):
+    return []
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps, fuse_sequence_parallel_allreduce=False):
+    # GSPMD already reduces SP-parameter grads correctly; hook kept for parity
+    return []

@@ -1,0 +1,92 @@
+"""DistributedStrategy (reference: protobuf-backed
+python/paddle/distributed/fleet/base/distributed_strategy.py +
+distributed_strategy.proto — SURVEY.md §5.6).  Same field names, plain
+python; maps onto mesh degrees + jit/GSPMD configuration."""
+
+from __future__ import annotations
+
+import copy
+
+
+class _Config(dict):
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError as e:
+            raise AttributeError(k) from e
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # mirrors of the proto's message fields
+        self.amp = False
+        self.amp_configs = _Config(
+            init_loss_scaling=32768.0,
+            incr_every_n_steps=1000,
+            decr_every_n_nan_or_inf=2,
+            incr_ratio=2.0,
+            decr_ratio=0.5,
+            use_dynamic_loss_scaling=True,
+            custom_white_list=[],
+            custom_black_list=[],
+            use_pure_fp16=False,
+            use_fp16_guard=True,
+            use_bf16=True,
+        )
+        self.recompute = False
+        self.recompute_configs = _Config(checkpoints=[], enable_offload=False)
+        self.pipeline = False
+        self.pipeline_configs = _Config(
+            accumulate_steps=1, micro_batch_size=1, schedule_mode="1F1B"
+        )
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = _Config(tensor_parallel_degree=1, tensor_init_seed=-1)
+        self.sharding = False
+        self.sharding_configs = _Config(
+            sharding_degree=1, stage=1, offload=False, segment_broadcast_MB=32.0
+        )
+        self.hybrid_configs = _Config(
+            dp_degree=1,
+            mp_degree=1,
+            pp_degree=1,
+            sharding_degree=1,
+            sep_degree=1,
+            order=["dp", "pp", "sharding", "sep", "mp"],
+        )
+        self.gradient_merge = False
+        self.gradient_merge_configs = _Config(k_steps=1, avg=True)
+        self.lamb = False
+        self.lamb_configs = _Config(lamb_weight_decay=0.01, exclude_from_weight_decay=[])
+        self.lars = False
+        self.lars_configs = _Config(lars_coeff=0.001, lars_weight_decay=0.0005)
+        self.localsgd = False
+        self.localsgd_configs = _Config(k_steps=1, begin_step=1)
+        self.dgc = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.find_unused_parameters = False
+        self.heter_ccl_mode = False
+        self.is_fl_ps_mode = False
+        self.a_sync = False
+        self.a_sync_configs = _Config(k_steps=-1)
+        self.auto = False
+        self.semi_auto = False
+        self.auto_search = False
+
+    def __setattr__(self, key, value):
+        if key.endswith("_configs") and hasattr(self, key):
+            cfg = getattr(self, key)
+            if isinstance(value, dict):
+                merged = _Config(copy.deepcopy(dict(cfg)))
+                merged.update(value)
+                object.__setattr__(self, key, merged)
+                return
+        object.__setattr__(self, key, value)
+
+    def __repr__(self):
+        fields = {k: v for k, v in self.__dict__.items() if not k.startswith("_")}
+        return f"DistributedStrategy({fields})"

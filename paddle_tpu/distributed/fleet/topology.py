@@ -1,0 +1,144 @@
+"""Hybrid topology (reference: python/paddle/distributed/fleet/base/topology.py
+CommunicateTopology + HybridCommunicateGroup — SURVEY.md §2.2).
+
+The 4-5D process grid maps 1:1 onto the global jax Mesh axes; per-axis
+"communication groups" are Group objects naming a mesh axis, so collectives
+lower onto the right ICI ring automatically.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import mesh as _mesh
+from ..collective import Group
+from ..env import get_rank
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep", "model"), dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    get_dim_size = get_dim
+
+
+_NAME2AXIS = {
+    "data": "dp",
+    "pipe": "pp",
+    "sharding": "sharding",
+    "sep": "sep",
+    "model": "mp",
+}
+
+
+class HybridCommunicateGroup:
+    def __init__(self, topology=None, dp_degree=1, mp_degree=1, pp_degree=1, sharding_degree=1, sep_degree=1):
+        if topology is not None:
+            dims = {n: topology.get_dim(n) for n in topology.get_hybrid_group_names()}
+            dp_degree = dims.get("data", 1)
+            pp_degree = dims.get("pipe", 1)
+            sharding_degree = dims.get("sharding", 1)
+            sep_degree = dims.get("sep", 1)
+            mp_degree = dims.get("model", 1)
+        self._dp_degree = dp_degree
+        self._mp_degree = mp_degree
+        self._pp_degree = pp_degree
+        self._sharding_degree = sharding_degree
+        self._sep_degree = sep_degree
+        _mesh.build_mesh(dp=dp_degree, mp=mp_degree, pp=pp_degree, sharding=sharding_degree, sep=sep_degree)
+        self._dp_group = Group(axis_name="dp")
+        self._mp_group = Group(axis_name="mp")
+        self._pp_group = Group(axis_name="pp")
+        self._sharding_group = Group(axis_name="sharding")
+        self._sep_group = Group(axis_name="sep")
+
+    # degrees
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    # ranks — single-controller: rank of this process along each axis is 0;
+    # per-device ranks materialize inside compiled SPMD programs
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sep_parallel_rank(self):
+        return 0
+
+    # groups
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sep_parallel_group(self):
+        return self._sep_group
+
+    def get_check_parallel_group(self, *a):
+        return Group()
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    def topology(self):
+        return CommunicateTopology(
+            dims=(self._dp_degree, self._pp_degree, self._sharding_degree, self._sep_degree, self._mp_degree)
+        )
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return stage_id
+
+
+_hcg = None
+
+
+def set_hybrid_communicate_group(hcg):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group():
+    global _hcg
+    if _hcg is None:
+        _hcg = HybridCommunicateGroup()
+    return _hcg

@@ -289,6 +289,31 @@ def set_active_trace(tr):
     return old
 
 
+# Birth registry: tensors created while a jit trace is active are "trace-born"
+# and excluded from implicit state capture (see paddle_tpu/jit).  Side table
+# because Tensor uses __slots__.
+import weakref as _weakref
+
+_birth = {}  # id(tensor) -> (weakref, trace token)
+
+
+def mark_born_if_tracing(t):
+    tr = _mode.trace
+    if tr is not None:
+        _birth[id(t)] = (_weakref.ref(t), tr.token)
+
+
+def get_born_token(t):
+    rec = _birth.get(id(t))
+    if rec is None:
+        return None
+    ref, token = rec
+    if ref() is not t:
+        _birth.pop(id(t), None)
+        return None
+    return token
+
+
 def active_amp():
     return _mode.amp
 

@@ -1,3 +1,102 @@
-# placeholder during bring-up
+"""hapi — paddle.Model high-level fit/evaluate/predict
+(reference: python/paddle/hapi/model.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops
+from ..io import DataLoader
+from ..tensor import Tensor
+
+
 class Model:
-    pass
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else ([metrics] if metrics else [])
+
+    def train_batch(self, inputs, labels=None):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        out = self.network(*inputs)
+        loss = self._loss(out, labels if not isinstance(labels, (list, tuple)) else labels[0])
+        loss.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        return [float(loss.numpy())]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        out = self.network(*inputs)
+        loss = self._loss(out, labels if not isinstance(labels, (list, tuple)) else labels[0])
+        return [float(loss.numpy())]
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        return self.network(*inputs)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1, eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2, drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
+            train_data, batch_size=batch_size, shuffle=shuffle, drop_last=drop_last, num_workers=num_workers
+        )
+        history = []
+        for epoch in range(epochs):
+            losses = []
+            for step, batch in enumerate(loader):
+                x, y = batch[0], batch[1]
+                loss = self.train_batch(x, y)[0]
+                losses.append(loss)
+                if verbose and step % log_freq == 0:
+                    print(f"epoch {epoch} step {step}: loss {loss:.5f}")
+            history.append(float(np.mean(losses)))
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size, verbose=verbose)
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2, num_workers=0, callbacks=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else DataLoader(eval_data, batch_size=batch_size)
+        losses = []
+        for batch in loader:
+            x, y = batch[0], batch[1]
+            losses.append(self.eval_batch(x, y)[0])
+        result = {"loss": float(np.mean(losses))}
+        if verbose:
+            print(f"eval: {result}")
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False, callbacks=None):
+        loader = test_data if isinstance(test_data, DataLoader) else DataLoader(test_data, batch_size=batch_size)
+        outs = []
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outs.append(self.predict_batch(x))
+        return outs
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def save(self, path, training=True):
+        from ..framework.io import save
+
+        save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load
+
+        self.network.set_state_dict(load(path + ".pdparams"))
+
+    def summary(self, input_size=None, dtype=None):
+        total = sum(p.size for p in self.network.parameters())
+        print(f"Total params: {total}")
+        return {"total_params": total}

@@ -1,1 +1,46 @@
-# placeholder during bring-up
+"""paddle.incubate (reference: python/paddle/incubate/) — MoE, recompute,
+fused-op wrappers."""
+
+from . import recompute as _recompute_mod  # noqa: F401
+from .recompute import recompute  # noqa: F401
+
+
+class nn:
+    class functional:
+        @staticmethod
+        def fused_multi_head_attention(*a, **k):
+            raise NotImplementedError("use paddle_tpu.nn.functional.scaled_dot_product_attention (Pallas flash)")
+
+        @staticmethod
+        def fused_feedforward(*a, **k):
+            raise NotImplementedError("XLA fuses the FFN automatically under @to_static")
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    from ..nn.functional import softmax
+    from ..ops.dispatch import apply, coerce
+    import jax.numpy as jnp
+
+    def f(a):
+        s, k = a.shape[-2], a.shape[-1]
+        import jax
+
+        qi = jax.lax.broadcasted_iota(jnp.int32, (s, k), 0)
+        ki = jax.lax.broadcasted_iota(jnp.int32, (s, k), 1)
+        masked = jnp.where(qi >= ki, a, -1e30)
+        return jax.nn.softmax(masked, axis=-1)
+
+    return apply(f, [coerce(x)], name="softmax_mask_fuse_upper_triangle")
+
+
+class distributed:
+    class models:
+        class moe:
+            from ..nn.layer import Layer as _Layer
+
+            class MoELayer(_Layer):
+                """Placeholder — full MoE with alltoall EP dispatch lands in
+                incubate.moe (M8); see paddle_tpu/incubate/moe.py."""
+
+                def __init__(self, *a, **k):
+                    raise NotImplementedError("use paddle_tpu.incubate.moe.MoELayer")

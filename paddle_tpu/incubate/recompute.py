@@ -1,0 +1,49 @@
+"""Recompute / activation checkpointing (reference:
+python/paddle/distributed/fleet/utils/recompute.py — SURVEY.md §5.7).
+
+TPU-native: jax.checkpoint (rematerialization) wraps the segment — XLA
+re-executes the forward inside the backward instead of storing activations.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..ops.dispatch import apply, coerce
+from ..tensor import Tensor
+
+
+def recompute(function, *args, use_reentrant=True, **kwargs):
+    tensor_args = []
+    spec = []
+    for a in args:
+        if isinstance(a, Tensor):
+            spec.append(("t", len(tensor_args)))
+            tensor_args.append(a)
+        else:
+            spec.append(("s", a))
+
+    def f(*arrays):
+        rebuilt = []
+        for kind, v in spec:
+            if kind == "t":
+                t = Tensor.__new__(Tensor)
+                t._init_from_array(arrays[v], stop_gradient=False)
+                rebuilt.append(t)
+            else:
+                rebuilt.append(v)
+        out = function(*rebuilt, **kwargs)
+        if isinstance(out, Tensor):
+            return out._data
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+        return out
+
+    ckpt = jax.checkpoint(f)
+    return apply(ckpt, [coerce(t) for t in tensor_args], name="recompute", multi=False)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    for fn in functions:
+        args = (recompute(fn, *args, **kwargs),)
+    return args[0]

@@ -1,3 +1,357 @@
-# placeholder during bring-up
-def to_static(fn=None, **kw):
-    raise NotImplementedError('to_static lands in M3')
+"""paddle_tpu.jit — whole-step XLA compilation (the TPU-native re-design of
+the reference's dy2static + static-graph executor stack: python/paddle/jit/
+to_static AST transforms + paddle/fluid/framework/new_executor InterpreterCore
+— SURVEY.md §2.1/§3.3).
+
+Instead of AST rewriting into a ProgramDesc interpreted by a C++ executor,
+`to_static(fn)` TRACES the imperative function (model forward, loss.backward(),
+optimizer.step() — the full train step) into ONE jax-jitted XLA program:
+
+1. discover phase — run fn under jax.eval_shape with trace interception on
+   every Tensor's data/grad slot: reads of pre-existing tensors (params,
+   optimizer moments, RNG key, BN stats, LR) are recorded as implicit state
+   inputs; writes as state outputs.
+2. execute phase — run fn again inside jax.jit where each recorded state slot
+   is substituted with the corresponding jit tracer; returns (user outputs,
+   final state values).  Read-write state is donated so parameter updates
+   reuse HBM buffers in place.
+3. steady state — calls dispatch straight to the compiled executable; Python
+   in fn never runs again (the contract of the reference's static graph).
+
+Re-traces on new input signatures (shape/dtype/tree) like the reference's
+program cache keyed on InputSpec.
+"""
+
+from __future__ import annotations
+
+import functools
+import weakref
+
+import numpy as np
+import jax
+
+from ..framework import core as _core
+from ..tensor import Tensor
+
+_MISS = object()
+
+# callables run before each compiled invocation to refresh host-driven state
+# (e.g. optimizer LR from a scheduler) — keyed weakly by owner object.
+_state_refreshers = weakref.WeakKeyDictionary()
+
+
+def register_state_refresh(owner, fn):
+    _state_refreshers[owner] = fn
+
+
+def _run_refreshers():
+    for owner, fn in list(_state_refreshers.items()):
+        fn(owner)
+
+
+class _Trace:
+    """State-slot interception for one traced call (phase = discover|execute)."""
+
+    __slots__ = ("phase", "overlay", "reads", "writes", "subst", "token")
+
+    def __init__(self, phase, subst=None):
+        self.phase = phase
+        self.overlay = {}
+        self.reads = {}
+        self.writes = {}
+        self.subst = subst or {}
+        self.token = object()
+
+    @staticmethod
+    def _slot_value(t, kind):
+        return t._raw if kind == "data" else t._grad_raw
+
+    def read(self, t, kind):
+        key = (id(t), kind)
+        if key in self.overlay:
+            return self.overlay[key]
+        if self.phase == "execute":
+            sub = self.subst.get(key, _MISS)
+            if sub is not _MISS:
+                return sub
+            return self._slot_value(t, kind)
+        val = self._slot_value(t, kind)
+        if (
+            val is not None
+            and not isinstance(val, jax.core.Tracer)
+            and _core.get_born_token(t) is not self.token
+        ):
+            self.reads.setdefault(key, (t, kind))
+        return val
+
+    def write(self, t, kind, value):
+        key = (id(t), kind)
+        self.overlay[key] = value
+        if _core.get_born_token(t) is not self.token:
+            self.writes.setdefault(key, (t, kind))
+
+
+def _flatten_structure(obj, tensor_sink):
+    """Recursively replace Tensors with placeholders, collecting them."""
+    if isinstance(obj, Tensor):
+        tensor_sink.append(obj)
+        return ("__tensor__", len(tensor_sink) - 1)
+    if isinstance(obj, (list, tuple)):
+        items = [_flatten_structure(o, tensor_sink) for o in obj]
+        return tuple(items) if isinstance(obj, tuple) else items
+    if isinstance(obj, dict):
+        return {k: _flatten_structure(v, tensor_sink) for k, v in obj.items()}
+    return obj
+
+
+def _rebuild_structure(obj, tensors):
+    if isinstance(obj, tuple) and len(obj) == 2 and obj[0] == "__tensor__":
+        return tensors[obj[1]]
+    if isinstance(obj, list):
+        return [_rebuild_structure(o, tensors) for o in obj]
+    if isinstance(obj, tuple):
+        return tuple(_rebuild_structure(o, tensors) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _rebuild_structure(v, tensors) for k, v in obj.items()}
+    return obj
+
+
+def _struct_signature(obj):
+    """Cache key for args: tensor shapes/dtypes + static values."""
+    if isinstance(obj, Tensor):
+        return ("T", tuple(obj._raw.shape), str(obj._raw.dtype))
+    if isinstance(obj, (list, tuple)):
+        return (type(obj).__name__,) + tuple(_struct_signature(o) for o in obj)
+    if isinstance(obj, dict):
+        return ("dict",) + tuple(sorted((k, _struct_signature(v)) for k, v in obj.items()))
+    if isinstance(obj, np.ndarray):
+        return ("A", obj.shape, str(obj.dtype))
+    return ("S", repr(obj))
+
+
+class _CompiledEntry:
+    __slots__ = ("jitted", "state_in", "rw_flags", "state_out", "none_out", "out_template", "boxes")
+
+
+class StaticFunction:
+    """Callable wrapper produced by @to_static (reference analogue:
+    paddle.jit.dy2static StaticFunction with its program cache)."""
+
+    def __init__(self, fn, donate=True):
+        self._fn = fn
+        self._donate = donate
+        self._cache = {}
+        functools.update_wrapper(self, fn)
+
+    # -- tracing --------------------------------------------------------
+    def _trace(self, args, kwargs):
+        fn = self._fn
+        in_tensors = []
+        args_tpl = _flatten_structure((args, kwargs), in_tensors)
+        in_structs = [jax.ShapeDtypeStruct(t._raw.shape, t._raw.dtype) for t in in_tensors]
+        in_flags = [t.stop_gradient for t in in_tensors]
+        del in_tensors  # don't capture the first batch in closures
+
+        # ---- phase 1: discover state reads/writes (no compute)
+        discover = _Trace("discover")
+
+        def discover_wrapper(arrs):
+            tensors = []
+            for a, sg in zip(arrs, in_flags):
+                t = Tensor.__new__(Tensor)
+                old0 = _core.set_active_trace(discover)
+                t._init_from_array(a, stop_gradient=sg)
+                _core.set_active_trace(old0)
+                tensors.append(t)
+            a2, k2 = _rebuild_structure(args_tpl, tensors)
+            old = _core.set_active_trace(discover)
+            try:
+                out = fn(*a2, **k2)
+            finally:
+                _core.set_active_trace(old)
+            sink = []
+            _flatten_structure(out, sink)
+            return tuple(t._raw for t in sink)
+
+        jax.eval_shape(discover_wrapper, in_structs)
+
+        state_in = list(discover.reads.values())
+        write_keys = set(discover.writes.keys())
+        rw_flags = [(id(t), k) in write_keys for (t, k) in state_in]
+
+        # ---- phase 2: the jitted runner
+        boxes = {}
+
+        def runner(arg_arrays, ro_vals, rw_vals):
+            subst = {}
+            ro_i = rw_i = 0
+            for (t, kind), rw in zip(state_in, rw_flags):
+                if rw:
+                    subst[(id(t), kind)] = rw_vals[rw_i]
+                    rw_i += 1
+                else:
+                    subst[(id(t), kind)] = ro_vals[ro_i]
+                    ro_i += 1
+            tr = _Trace("execute", subst=subst)
+            tensors = []
+            for a, sg in zip(arg_arrays, in_flags):
+                t = Tensor.__new__(Tensor)
+                old0 = _core.set_active_trace(tr)
+                t._init_from_array(a, stop_gradient=sg)
+                _core.set_active_trace(old0)
+                tensors.append(t)
+            a2, k2 = _rebuild_structure(args_tpl, tensors)
+            old = _core.set_active_trace(tr)
+            try:
+                out = fn(*a2, **k2)
+            finally:
+                _core.set_active_trace(old)
+            sink = []
+            tpl = _flatten_structure(out, sink)
+            out_arrays = tuple(t._raw for t in sink)
+            s_out, s_none, s_vals = [], [], []
+            for key, (t, kind) in discover.writes.items():
+                v = tr.overlay.get(key, _MISS)
+                if v is _MISS or v is None:
+                    s_none.append((t, kind))
+                else:
+                    s_out.append((t, kind))
+                    s_vals.append(v)
+            # also surface execute-phase-only writes (should be rare)
+            for key, (t, kind) in tr.writes.items():
+                if key not in discover.writes:
+                    v = tr.overlay.get(key)
+                    if v is not None:
+                        s_out.append((t, kind))
+                        s_vals.append(v)
+            boxes["out"] = s_out
+            boxes["none"] = s_none
+            boxes["tpl"] = tpl
+            return out_arrays, tuple(s_vals)
+
+        entry = _CompiledEntry()
+        entry.state_in = state_in
+        entry.rw_flags = rw_flags
+        entry.jitted = jax.jit(runner, donate_argnums=(2,) if self._donate else ())
+        entry.state_out = None
+        entry.none_out = None
+        entry.out_template = None
+        entry.boxes = boxes
+        return entry
+
+    # -- call -----------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        if _core.active_trace() is not None:
+            return self._fn(*args, **kwargs)  # nested to_static: inline
+        _run_refreshers()
+        key = _struct_signature((args, tuple(sorted(kwargs.items()))))
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._trace(args, kwargs)
+            self._cache[key] = entry
+
+        in_tensors = []
+        _flatten_structure((args, kwargs), in_tensors)
+        arg_arrays = [t._raw for t in in_tensors]
+        ro_vals, rw_vals = [], []
+        stale = False
+        for (t, kind), rw in zip(entry.state_in, entry.rw_flags):
+            v = t._raw if kind == "data" else t._grad_raw
+            if v is None:
+                stale = True
+                break
+            (rw_vals if rw else ro_vals).append(v)
+        if stale:
+            # state layout changed (e.g. grads cleared differently) — re-trace
+            entry = self._trace(args, kwargs)
+            self._cache[key] = entry
+            ro_vals, rw_vals = [], []
+            for (t, kind), rw in zip(entry.state_in, entry.rw_flags):
+                v = t._raw if kind == "data" else t._grad_raw
+                (rw_vals if rw else ro_vals).append(v)
+
+        out_arrays, state_vals = entry.jitted(arg_arrays, ro_vals, rw_vals)
+
+        if entry.state_out is None:
+            entry.state_out = entry.boxes["out"]
+            entry.none_out = entry.boxes["none"]
+            entry.out_template = entry.boxes["tpl"]
+
+        for (t, kind), v in zip(entry.state_out, state_vals):
+            if kind == "data":
+                t._raw = v
+            else:
+                t._grad_raw = v
+        for (t, kind) in entry.none_out:
+            if kind == "grad":
+                t._grad_raw = None
+
+        out_tensors = []
+        for a in out_arrays:
+            t = Tensor.__new__(Tensor)
+            t._init_from_array(a, stop_gradient=True)
+            out_tensors.append(t)
+        return _rebuild_structure(entry.out_template, out_tensors)
+
+    def clear_cache(self):
+        self._cache.clear()
+
+    @property
+    def code(self):
+        import inspect
+
+        return inspect.getsource(self._fn)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+    """Decorator: compile a train/eval step into one XLA program."""
+
+    def wrap(fn):
+        if isinstance(fn, StaticFunction):
+            return fn
+        return StaticFunction(fn, donate=kwargs.get("donate", True))
+
+    if function is not None:
+        return wrap(function)
+    return wrap
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+class InputSpec:
+    """Shape/dtype spec (reference: paddle.static.InputSpec) — accepted for
+    API compat; tracing specializes on concrete shapes."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=False):
+        self.shape = shape
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save — persists weights; programs re-trace on load (XLA
+    executables are machine-specific, unlike the reference's ProgramDesc)."""
+    from ..framework.io import save as _save
+
+    if hasattr(layer, "state_dict"):
+        _save(layer.state_dict(), path + ".pdparams")
+    else:
+        raise TypeError("jit.save expects a Layer")
+
+
+def load(path, **configs):
+    from ..framework.io import load as _load
+
+    return _load(path + ".pdparams")
+
+
+def enable_to_static(flag=True):
+    pass
+
+
+def ignore_module(modules):
+    pass

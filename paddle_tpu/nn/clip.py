@@ -1,0 +1,101 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import ops
+from ..ops.dispatch import apply, coerce
+from ..tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = min if min is not None else -max
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, ops.clip(g, self.min, self.max)))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            cn = self.clip_norm
+            clipped = apply(
+                lambda a: a * jnp.minimum(1.0, cn / jnp.maximum(jnp.sqrt(jnp.sum(a * a)), 1e-12)),
+                [coerce(g)],
+                name="clip_by_norm",
+            )
+            out.append((p, clipped))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        grads = [g for p, g in params_grads if g is not None and getattr(p, "need_clip", True)]
+        if not grads:
+            return params_grads
+        cn = self.clip_norm
+
+        ins = [coerce(g) for g in grads]
+        gnorm = apply(
+            lambda *gs: jnp.sqrt(
+                sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in gs)
+            ),
+            ins,
+            name="global_norm",
+        )
+        scale = apply(
+            lambda n: jnp.minimum(1.0, cn / jnp.maximum(n, 1e-12)), [gnorm], name="clip_scale"
+        )
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, apply(lambda a, s: a * s.astype(a.dtype), [coerce(g), scale], name="clip_apply")))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    pgs = [(p, p.grad) for p in parameters if p.grad is not None]
+    clip = ClipGradByGlobalNorm(max_norm)
+    for p, g in clip(pgs):
+        p.grad = g
+    total = apply(
+        lambda *gs: jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in gs)),
+        [coerce(g) for _, g in pgs],
+    )
+    return total
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad = ops.clip(p.grad, -clip_value, clip_value)

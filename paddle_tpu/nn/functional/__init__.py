@@ -1,0 +1,1241 @@
+"""nn.functional (reference: python/paddle/nn/functional/) — XLA lowerings.
+
+Convs/pools use lax.conv_general_dilated / lax.reduce_window (MXU-friendly,
+NCHW accepted and handled natively by XLA layout assignment); norms are
+written so XLA fuses them; attention routes to the Pallas flash kernel.
+"""
+
+from __future__ import annotations
+
+import math
+import numbers
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...framework import core as _core
+from ...framework.random import default_generator
+from ...tensor import Tensor
+from ...ops.dispatch import apply, coerce, amp_cast_inputs
+from ...ops import matmul as _matmul
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+
+def _unary(fn, name):
+    def op(x, *args, **kwargs):
+        x = coerce(x)
+        return apply(fn, [x], name=name)
+
+    op.__name__ = name
+    return op
+
+
+relu = _unary(jax.nn.relu, "relu")
+relu6 = _unary(jax.nn.relu6, "relu6")
+sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
+tanh = _unary(jnp.tanh, "tanh")
+silu = _unary(jax.nn.silu, "silu")
+swish = silu
+mish = _unary(lambda a: a * jnp.tanh(jax.nn.softplus(a)), "mish")
+tanhshrink = _unary(lambda a: a - jnp.tanh(a), "tanhshrink")
+softsign = _unary(jax.nn.soft_sign, "softsign")
+hardswish = _unary(jax.nn.hard_swish, "hardswish")
+hardsigmoid = _unary(lambda a: jnp.clip(a / 6.0 + 0.5, 0.0, 1.0), "hardsigmoid")
+
+
+def relu_(x):
+    from ...ops.dispatch import inplace_rebind
+
+    return inplace_rebind(x, relu(x))
+
+
+def gelu(x, approximate=False, name=None):
+    x = coerce(x)
+    return apply(lambda a: jax.nn.gelu(a, approximate=approximate), [x], name="gelu")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    x = coerce(x)
+    return apply(lambda a: jax.nn.leaky_relu(a, negative_slope), [x], name="leaky_relu")
+
+
+def elu(x, alpha=1.0, name=None):
+    x = coerce(x)
+    return apply(lambda a: jax.nn.elu(a, alpha), [x], name="elu")
+
+
+def celu(x, alpha=1.0, name=None):
+    x = coerce(x)
+    return apply(lambda a: jax.nn.celu(a, alpha), [x], name="celu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    x = coerce(x)
+    return apply(
+        lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), [x], name="selu"
+    )
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = coerce(x), coerce(weight)
+
+    def f(a, w):
+        if w.size > 1:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(a > 0, a, w * a)
+
+    return apply(f, [x, weight], name="prelu")
+
+
+def rrelu(x, lower=0.125, upper=0.333, training=False, name=None):
+    x = coerce(x)
+    if training:
+        key = default_generator.next_key()
+        return apply(
+            lambda a: jnp.where(
+                a >= 0, a, a * jax.random.uniform(key, a.shape, a.dtype, lower, upper)
+            ),
+            [x],
+            name="rrelu",
+        )
+    mid = (lower + upper) / 2
+    return apply(lambda a: jnp.where(a >= 0, a, a * mid), [x], name="rrelu")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.clip(a, min, max), [x], name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    x = coerce(x)
+    return apply(
+        lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0).astype(a.dtype), [x]
+    )
+
+
+def softshrink(x, threshold=0.5, name=None):
+    x = coerce(x)
+    return apply(
+        lambda a: jnp.where(
+            a > threshold, a - threshold, jnp.where(a < -threshold, a + threshold, 0.0)
+        ).astype(a.dtype),
+        [x],
+    )
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    x = coerce(x)
+    return apply(
+        lambda a: jnp.where(a * beta > threshold, a, jax.nn.softplus(a * beta) / beta),
+        [x],
+        name="softplus",
+    )
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = coerce(x)
+
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        newshape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1 :]
+        return jnp.max(a.reshape(newshape), axis=ax + 1)
+
+    return apply(f, [x], name="maxout")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = coerce(x)
+    (x,) = amp_cast_inputs([x], "black")
+    return apply(lambda a: jax.nn.softmax(a, axis=axis), [x], name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = coerce(x)
+    (x,) = amp_cast_inputs([x], "black")
+    return apply(lambda a: jax.nn.log_softmax(a, axis=axis), [x], name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    x = coerce(x)
+    key = default_generator.next_key()
+
+    def f(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y).at[...].set(
+                jnp.where(
+                    jnp.arange(y.shape[axis]).reshape(
+                        [-1 if i == (axis % y.ndim) else 1 for i in range(y.ndim)]
+                    )
+                    == idx,
+                    1.0,
+                    0.0,
+                ).astype(y.dtype)
+            )
+            return y_hard - lax.stop_gradient(y) + y
+        return y
+
+    return apply(f, [x], name="gumbel_softmax")
+
+
+def glu(x, axis=-1, name=None):
+    x = coerce(x)
+    return apply(lambda a: jax.nn.glu(a, axis=axis), [x], name="glu")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    x = coerce(x)
+    return apply(
+        lambda a: a
+        / jnp.maximum(
+            jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p), epsilon
+        ),
+        [x],
+        name="normalize",
+    )
+
+
+# ---------------------------------------------------------------------------
+# linear / embedding
+# ---------------------------------------------------------------------------
+
+
+def linear(x, weight, bias=None, name=None):
+    """paddle semantics: weight shape [in_features, out_features]."""
+    x, weight = coerce(x), coerce(weight)
+    ins = [x, weight]
+    if bias is not None:
+        ins.append(coerce(bias))
+    ins = amp_cast_inputs(ins, "white")
+
+    def f(a, w, *b):
+        out = jnp.matmul(a, w)
+        if b:
+            out = out + b[0]
+        return out
+
+    return apply(f, ins, name="linear")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None, max_norm=None, norm_type=2.0, scale_grad_by_freq=False):
+    x, weight = coerce(x), coerce(weight)
+
+    def f(i, w):
+        idx = i.astype(jnp.int32)
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros((), w.dtype), out)
+        return out
+
+    return apply(f, [x, weight], name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops.manipulation import one_hot as _oh
+
+    return _oh(x, num_classes)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = coerce(label)
+    n = label.shape[-1]
+    if prior_dist is not None:
+        prior_dist = coerce(prior_dist)
+        return apply(
+            lambda l, p: (1 - epsilon) * l + epsilon * p, [label, prior_dist]
+        )
+    return apply(lambda l: (1 - epsilon) * l + epsilon / n, [label], name="label_smooth")
+
+
+# ---------------------------------------------------------------------------
+# convolution
+# ---------------------------------------------------------------------------
+
+
+def _tuplize(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(x) for x in v)
+    return tuple(int(v) for _ in range(n))
+
+
+def _conv_padding(padding, nsp, strides, kernel, dilation):
+    """Returns lax padding spec: 'SAME'/'VALID' or list of (lo, hi)."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)) and len(padding) and isinstance(padding[0], (list, tuple)):
+        # [[0,0],[0,0],[h0,h1],[w0,w1]] paddle style or per-dim pairs
+        pairs = [tuple(p) for p in padding]
+        if len(pairs) == nsp:
+            return pairs
+        return pairs[-nsp:]
+    p = _tuplize(padding, nsp)
+    if len(p) == 2 * nsp:
+        return [(p[2 * i], p[2 * i + 1]) for i in range(nsp)]
+    return [(pi, pi) for pi in p]
+
+
+def conv2d(
+    x,
+    weight,
+    bias=None,
+    stride=1,
+    padding=0,
+    dilation=1,
+    groups=1,
+    data_format="NCHW",
+    name=None,
+):
+    x, weight = coerce(x), coerce(weight)
+    ins = [x, weight]
+    if bias is not None:
+        ins.append(coerce(bias))
+    ins = amp_cast_inputs(ins, "white")
+    strides = _tuplize(stride, 2)
+    dil = _tuplize(dilation, 2)
+    pad = _conv_padding(padding, 2, strides, None, dil)
+    dn = ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "HWIO", "NHWC")
+
+    def f(a, w, *b):
+        if data_format == "NHWC":
+            w = jnp.transpose(w, (2, 3, 1, 0))
+        out = lax.conv_general_dilated(
+            a,
+            w,
+            window_strides=strides,
+            padding=pad,
+            rhs_dilation=dil,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+        if b:
+            bias_arr = b[0]
+            shape = [1, -1, 1, 1] if data_format == "NCHW" else [1, 1, 1, -1]
+            out = out + bias_arr.reshape(shape)
+        return out
+
+    return apply(f, ins, name="conv2d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    x, weight = coerce(x), coerce(weight)
+    ins = [x, weight]
+    if bias is not None:
+        ins.append(coerce(bias))
+    ins = amp_cast_inputs(ins, "white")
+    strides = _tuplize(stride, 1)
+    dil = _tuplize(dilation, 1)
+    pad = _conv_padding(padding, 1, strides, None, dil)
+    dn = ("NCH", "OIH", "NCH") if data_format == "NCL" else ("NHC", "HIO", "NHC")
+
+    def f(a, w, *b):
+        out = lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad, rhs_dilation=dil,
+            dimension_numbers=dn, feature_group_count=groups,
+        )
+        if b:
+            shape = [1, -1, 1] if data_format == "NCL" else [1, 1, -1]
+            out = out + b[0].reshape(shape)
+        return out
+
+    return apply(f, ins, name="conv1d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    x, weight = coerce(x), coerce(weight)
+    ins = [x, weight]
+    if bias is not None:
+        ins.append(coerce(bias))
+    ins = amp_cast_inputs(ins, "white")
+    strides = _tuplize(stride, 3)
+    dil = _tuplize(dilation, 3)
+    pad = _conv_padding(padding, 3, strides, None, dil)
+    dn = ("NCDHW", "OIDHW", "NCDHW")
+
+    def f(a, w, *b):
+        out = lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad, rhs_dilation=dil,
+            dimension_numbers=dn, feature_group_count=groups,
+        )
+        if b:
+            out = out + b[0].reshape([1, -1, 1, 1, 1])
+        return out
+
+    return apply(f, ins, name="conv3d")
+
+
+def conv2d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0,
+    groups=1, dilation=1, data_format="NCHW", output_size=None, name=None,
+):
+    x, weight = coerce(x), coerce(weight)
+    ins = [x, weight]
+    if bias is not None:
+        ins.append(coerce(bias))
+    ins = amp_cast_inputs(ins, "white")
+    strides = _tuplize(stride, 2)
+    dil = _tuplize(dilation, 2)
+    pad = _conv_padding(padding, 2, strides, None, dil)
+    opad = _tuplize(output_padding, 2)
+
+    def f(a, w, *b):
+        # weight layout: [in_c, out_c/groups, kh, kw] (paddle transpose-conv)
+        kh, kw = w.shape[2], w.shape[3]
+        if isinstance(pad, str):
+            padding_pairs = pad
+        else:
+            padding_pairs = [
+                (dil[i] * (k - 1) - pad[i][0], dil[i] * (k - 1) - pad[i][1] + opad[i])
+                for i, k in enumerate((kh, kw))
+            ]
+        w2 = jnp.flip(w, (2, 3))  # IOHW → rotate
+        w2 = jnp.transpose(w2, (1, 0, 2, 3))  # → [out_c/g, in_c, kh, kw]
+        if groups > 1:
+            # split input channels into groups for grouped transpose conv
+            ic = a.shape[1]
+            outs = []
+            icg = ic // groups
+            ocg = w2.shape[0]
+            for g in range(groups):
+                outs.append(
+                    lax.conv_general_dilated(
+                        a[:, g * icg : (g + 1) * icg],
+                        w2[:, g * icg - g * icg : icg] if False else jnp.transpose(jnp.flip(w[g * icg : (g + 1) * icg], (2, 3)), (1, 0, 2, 3)),
+                        window_strides=(1, 1),
+                        padding=padding_pairs,
+                        lhs_dilation=strides,
+                        rhs_dilation=dil,
+                        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                    )
+                )
+            out = jnp.concatenate(outs, axis=1)
+        else:
+            out = lax.conv_general_dilated(
+                a, w2, window_strides=(1, 1), padding=padding_pairs,
+                lhs_dilation=strides, rhs_dilation=dil,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            )
+        if b:
+            out = out + b[0].reshape([1, -1, 1, 1])
+        return out
+
+    return apply(f, ins, name="conv2d_transpose")
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False, data_format="NCHW", name=None):
+    x = coerce(x)
+    k = _tuplize(kernel_size, 2)
+    s = _tuplize(stride if stride is not None else kernel_size, 2)
+    pad = _conv_padding(padding, 2, s, k, (1, 1))
+    if isinstance(pad, str):
+        pad_spec = pad
+    else:
+        pad_spec = [(0, 0), (0, 0)] + list(pad)
+
+    def f(a):
+        dims = (1, 1) + k
+        strides = (1, 1) + s
+        p = pad_spec if isinstance(pad_spec, str) else pad_spec
+        init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+        return lax.reduce_window(a, init, lax.max, dims, strides, p)
+
+    out = apply(f, [x], name="max_pool2d")
+    if return_mask:
+        idx = apply(lambda a: jnp.zeros_like(a, jnp.int32), [out.detach()])
+        return out, idx
+    return out
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    x = coerce(x)
+    k = _tuplize(kernel_size, 2)
+    s = _tuplize(stride if stride is not None else kernel_size, 2)
+    pad = _conv_padding(padding, 2, s, k, (1, 1))
+    pad_spec = pad if isinstance(pad, str) else [(0, 0), (0, 0)] + list(pad)
+
+    def f(a):
+        dims = (1, 1) + k
+        strides = (1, 1) + s
+        summed = lax.reduce_window(a, 0.0, lax.add, dims, strides, pad_spec)
+        if divisor_override:
+            return summed / divisor_override
+        if exclusive and not isinstance(pad_spec, str):
+            ones = jnp.ones_like(a)
+            counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pad_spec)
+            return summed / counts
+        return summed / (k[0] * k[1])
+
+    return apply(f, [x], name="avg_pool2d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
+    x = coerce(x)
+    k = _tuplize(kernel_size, 1)
+    s = _tuplize(stride if stride is not None else kernel_size, 1)
+    pad = _conv_padding(padding, 1, s, k, (1,))
+    pad_spec = pad if isinstance(pad, str) else [(0, 0), (0, 0)] + list(pad)
+
+    def f(a):
+        return lax.reduce_window(a, -jnp.inf, lax.max, (1, 1) + k, (1, 1) + s, pad_spec)
+
+    return apply(f, [x], name="max_pool1d")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
+    x = coerce(x)
+    k = _tuplize(kernel_size, 1)
+    s = _tuplize(stride if stride is not None else kernel_size, 1)
+    pad = _conv_padding(padding, 1, s, k, (1,))
+    pad_spec = pad if isinstance(pad, str) else [(0, 0), (0, 0)] + list(pad)
+
+    def f(a):
+        summed = lax.reduce_window(a, 0.0, lax.add, (1, 1) + k, (1, 1) + s, pad_spec)
+        return summed / k[0]
+
+    return apply(f, [x], name="avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    x = coerce(x)
+    out_hw = _tuplize(output_size, 2)
+
+    def f(a):
+        n, c, h, w = a.shape
+        oh, ow = out_hw
+        if h % oh == 0 and w % ow == 0:
+            return a.reshape(n, c, oh, h // oh, ow, w // ow).mean((3, 5))
+        # general: mean over variable windows
+        rows = [a[:, :, (i * h) // oh : max((i * h) // oh + 1, ((i + 1) * h + oh - 1) // oh), :].mean(2, keepdims=True) for i in range(oh)]
+        a2 = jnp.concatenate(rows, 2)
+        cols = [a2[:, :, :, (j * w) // ow : max((j * w) // ow + 1, ((j + 1) * w + ow - 1) // ow)].mean(3, keepdims=True) for j in range(ow)]
+        return jnp.concatenate(cols, 3)
+
+    return apply(f, [x], name="adaptive_avg_pool2d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    x = coerce(x)
+    out_hw = _tuplize(output_size, 2)
+
+    def f(a):
+        n, c, h, w = a.shape
+        oh, ow = out_hw
+        if h % oh == 0 and w % ow == 0:
+            return a.reshape(n, c, oh, h // oh, ow, w // ow).max((3, 5))
+        rows = [a[:, :, (i * h) // oh : ((i + 1) * h + oh - 1) // oh, :].max(2, keepdims=True) for i in range(oh)]
+        a2 = jnp.concatenate(rows, 2)
+        cols = [a2[:, :, :, (j * w) // ow : ((j + 1) * w + ow - 1) // ow].max(3, keepdims=True) for j in range(ow)]
+        return jnp.concatenate(cols, 3)
+
+    return apply(f, [x], name="adaptive_max_pool2d")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    x = coerce(x)
+    o = int(output_size) if not isinstance(output_size, (list, tuple)) else int(output_size[0])
+
+    def f(a):
+        n, c, l = a.shape
+        if l % o == 0:
+            return a.reshape(n, c, o, l // o).mean(3)
+        parts = [a[:, :, (i * l) // o : ((i + 1) * l + o - 1) // o].mean(2, keepdims=True) for i in range(o)]
+        return jnp.concatenate(parts, 2)
+
+    return apply(f, [x], name="adaptive_avg_pool1d")
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    x = coerce(x)
+    (x,) = amp_cast_inputs([x], "black")
+    if isinstance(normalized_shape, numbers.Integral):
+        normalized_shape = (int(normalized_shape),)
+    naxes = tuple(range(-len(tuple(normalized_shape)), 0))
+    ins = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        ins.append(amp_cast_inputs([coerce(weight)], "black")[0])
+    if has_b:
+        ins.append(amp_cast_inputs([coerce(bias)], "black")[0])
+
+    def f(a, *wb):
+        mean = jnp.mean(a, axis=naxes, keepdims=True)
+        var = jnp.var(a, axis=naxes, keepdims=True)
+        out = (a - mean) * lax.rsqrt(var + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i]
+            i += 1
+        if has_b:
+            out = out + wb[i]
+        return out
+
+    return apply(f, ins, name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """TPU-native extension (reference counterpart: fused_rms_norm in
+    paddle/phi/kernels/fusion — standard in the Llama family)."""
+    x = coerce(x)
+    ins = [x]
+    if weight is not None:
+        ins.append(coerce(weight))
+
+    def f(a, *w):
+        dtype = a.dtype
+        a32 = a.astype(jnp.float32)
+        out = a32 * lax.rsqrt(jnp.mean(a32 * a32, axis=-1, keepdims=True) + epsilon)
+        out = out.astype(dtype)
+        if w:
+            out = out * w[0]
+        return out
+
+    return apply(f, ins, name="rms_norm")
+
+
+def batch_norm(
+    x,
+    running_mean,
+    running_var,
+    weight=None,
+    bias=None,
+    training=False,
+    momentum=0.9,
+    epsilon=1e-5,
+    data_format="NCHW",
+    use_global_stats=None,
+    name=None,
+):
+    x = coerce(x)
+    (x,) = amp_cast_inputs([x], "black")
+    ch_axis = 1 if data_format.startswith("NC") and x.ndim > 1 else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis] if x.ndim > 1 else 1
+
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        mean = apply(lambda a: jnp.mean(a, axis=reduce_axes), [x], name="bn_mean")
+        var = apply(lambda a: jnp.var(a, axis=reduce_axes), [x], name="bn_var")
+        # update running stats in-place (buffers)
+        if running_mean is not None:
+            from ... import ops as _ops
+
+            with _core.no_grad_ctx():
+                running_mean._data = (
+                    momentum * running_mean._data + (1 - momentum) * mean._data
+                )
+                n = int(np.prod([x.shape[i] for i in reduce_axes]))
+                unbiased = var._data * (n / max(n - 1, 1))
+                running_var._data = (
+                    momentum * running_var._data + (1 - momentum) * unbiased
+                )
+    else:
+        mean = coerce(running_mean)
+        var = coerce(running_var)
+
+    ins = [x, mean, var]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        ins.append(amp_cast_inputs([coerce(weight)], "black")[0])
+    if has_b:
+        ins.append(amp_cast_inputs([coerce(bias)], "black")[0])
+
+    def f(a, m, v, *wb):
+        out = (a - m.reshape(shape)) * lax.rsqrt(v.reshape(shape) + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    return apply(f, ins, name="batch_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW", name=None):
+    x = coerce(x)
+    ins = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        ins.append(coerce(weight))
+    if has_b:
+        ins.append(coerce(bias))
+
+    def f(a, *wb):
+        n, c = a.shape[0], a.shape[1]
+        spatial = a.shape[2:]
+        g = num_groups
+        a2 = a.reshape((n, g, c // g) + spatial)
+        axes = tuple(range(2, a2.ndim))
+        mean = jnp.mean(a2, axis=axes, keepdims=True)
+        var = jnp.var(a2, axis=axes, keepdims=True)
+        out = ((a2 - mean) * lax.rsqrt(var + epsilon)).reshape(a.shape)
+        shape = [1, c] + [1] * len(spatial)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    return apply(f, ins, name="group_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None, use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
+    x = coerce(x)
+    ins = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        ins.append(coerce(weight))
+    if has_b:
+        ins.append(coerce(bias))
+
+    def f(a, *wb):
+        axes = tuple(range(2, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * lax.rsqrt(var + eps)
+        shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    return apply(f, ins, name="instance_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    x = coerce(x)
+
+    def f(a):
+        sq = jnp.square(a)
+        half = size // 2
+        pads = [(0, 0)] * a.ndim
+        pads[1] = (half, size - half - 1)
+        sq_p = jnp.pad(sq, pads)
+        acc = jnp.zeros_like(a)
+        for i in range(size):
+            acc = acc + lax.slice_in_dim(sq_p, i, i + a.shape[1], axis=1)
+        return a / (k + alpha * acc) ** beta
+
+    return apply(f, [x], name="local_response_norm")
+
+
+# ---------------------------------------------------------------------------
+# dropout
+# ---------------------------------------------------------------------------
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    x = coerce(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply(lambda a: a * (1 - p), [x], name="dropout_infer")
+        return x
+    if p == 1.0:
+        return apply(lambda a: jnp.zeros_like(a), [x], name="dropout")
+    key = default_generator.next_key()
+
+    def f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), jnp.zeros((), a.dtype)).astype(a.dtype)
+        return jnp.where(keep, a, jnp.zeros((), a.dtype)).astype(a.dtype)
+
+    return apply(f, [x], name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    return dropout(x, p, axis=[0, 1] if data_format == "NCHW" else [0, 3], training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    return dropout(x, p, axis=[0, 1], training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = coerce(x)
+    if not training or p == 0.0:
+        return x
+    key = default_generator.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p**2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
+
+    return apply(f, [x], name="alpha_dropout")
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+
+def _reduce(v, reduction):
+    from ... import ops as _ops
+
+    if reduction == "mean":
+        return _ops.mean(v)
+    if reduction == "sum":
+        return _ops.sum(v)
+    return v
+
+
+def cross_entropy(
+    input,
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    label_smoothing=0.0,
+    name=None,
+):
+    input, label = coerce(input), coerce(label)
+    (input,) = amp_cast_inputs([input], "black")
+    ins = [input, label]
+    has_w = weight is not None
+    if has_w:
+        ins.append(coerce(weight))
+
+    def f(logits, lab, *w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        nclass = logits.shape[axis]
+        if soft_label:
+            tgt = lab.astype(logp.dtype)
+            if label_smoothing > 0:
+                tgt = (1 - label_smoothing) * tgt + label_smoothing / nclass
+            loss = -(tgt * logp).sum(axis=axis)
+            valid = jnp.ones(loss.shape, logp.dtype)
+        else:
+            idx = lab.astype(jnp.int32)
+            if idx.ndim == logp.ndim and idx.shape[axis] == 1:
+                idx = jnp.squeeze(idx, axis)
+            valid = (idx != ignore_index).astype(logp.dtype)
+            safe_idx = jnp.where(idx == ignore_index, 0, idx)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe_idx, axis), axis=axis
+            ).squeeze(axis)
+            if label_smoothing > 0:
+                smooth = -logp.mean(axis=axis)
+                loss = (1 - label_smoothing) * (-picked) + label_smoothing * smooth
+            else:
+                loss = -picked
+            loss = loss * valid
+            if w:
+                cw = jnp.take(w[0], safe_idx, axis=0) * valid
+                loss = loss * jnp.take(w[0], safe_idx, axis=0)
+                if reduction == "mean":
+                    return loss.sum() / jnp.maximum(cw.sum(), 1e-12)
+        if reduction == "mean":
+            return loss.sum() / jnp.maximum(valid.sum(), 1.0)
+        if reduction == "sum":
+            return loss.sum()
+        return loss
+
+    return apply(f, ins, name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index, reduction="none", axis=axis)
+    from ...ops.manipulation import unsqueeze
+
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    input, label = coerce(input), coerce(label)
+    ins = [input, label]
+    has_w = weight is not None
+    if has_w:
+        ins.append(coerce(weight))
+
+    def f(logp, lab, *w):
+        idx = lab.astype(jnp.int32)
+        valid = (idx != ignore_index).astype(logp.dtype)
+        safe = jnp.where(idx == ignore_index, 0, idx)
+        picked = jnp.take_along_axis(logp, safe[..., None] if logp.ndim == idx.ndim + 1 else safe, axis=1 if logp.ndim == 2 else 1)
+        if logp.ndim == 2:
+            picked = jnp.take_along_axis(logp, safe[:, None], axis=1)[:, 0]
+        loss = -picked * valid
+        if w:
+            cw = jnp.take(w[0], safe, axis=0)
+            loss = loss * cw
+            if reduction == "mean":
+                return loss.sum() / jnp.maximum((cw * valid).sum(), 1e-12)
+        if reduction == "mean":
+            return loss.sum() / jnp.maximum(valid.sum(), 1.0)
+        if reduction == "sum":
+            return loss.sum()
+        return loss
+
+    return apply(f, ins, name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    input, label = coerce(input), coerce(label)
+
+    def f(a, b):
+        d = jnp.square(a - b.astype(a.dtype))
+        if reduction == "mean":
+            return d.mean()
+        if reduction == "sum":
+            return d.sum()
+        return d
+
+    return apply(f, [input, label], name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    input, label = coerce(input), coerce(label)
+
+    def f(a, b):
+        d = jnp.abs(a - b.astype(a.dtype))
+        if reduction == "mean":
+            return d.mean()
+        if reduction == "sum":
+            return d.sum()
+        return d
+
+    return apply(f, [input, label], name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    input, label = coerce(input), coerce(label)
+
+    def f(a, b):
+        d = a - b.astype(a.dtype)
+        ad = jnp.abs(d)
+        loss = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+        if reduction == "mean":
+            return loss.mean()
+        if reduction == "sum":
+            return loss.sum()
+        return loss
+
+    return apply(f, [input, label], name="smooth_l1_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    input, label = coerce(input), coerce(label)
+    ins = [input, label] + ([coerce(weight)] if weight is not None else [])
+
+    def f(p, y, *w):
+        y = y.astype(p.dtype)
+        eps = 1e-12
+        loss = -(y * jnp.log(jnp.maximum(p, eps)) + (1 - y) * jnp.log(jnp.maximum(1 - p, eps)))
+        if w:
+            loss = loss * w[0]
+        return _red(loss)
+
+    def _red(loss):
+        if reduction == "mean":
+            return loss.mean()
+        if reduction == "sum":
+            return loss.sum()
+        return loss
+
+    return apply(f, ins, name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    logit, label = coerce(logit), coerce(label)
+    ins = [logit, label]
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+    if has_w:
+        ins.append(coerce(weight))
+    if has_pw:
+        ins.append(coerce(pos_weight))
+
+    def f(z, y, *rest):
+        y = y.astype(z.dtype)
+        i = 0
+        w = None
+        pw = None
+        if has_w:
+            w = rest[i]
+            i += 1
+        if has_pw:
+            pw = rest[i]
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)), with pos_weight variant
+        if pw is not None:
+            log_weight = (pw - 1) * y + 1
+            loss = (1 - y) * z + log_weight * (jnp.logaddexp(0.0, -jnp.abs(z)) + jnp.maximum(-z, 0.0))
+        else:
+            loss = jnp.maximum(z, 0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+        if w is not None:
+            loss = loss * w
+        if reduction == "mean":
+            return loss.mean()
+        if reduction == "sum":
+            return loss.sum()
+        return loss
+
+    return apply(f, ins, name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    input, label = coerce(input), coerce(label)
+
+    def f(lp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - lp)
+        else:
+            t = t.astype(lp.dtype)
+            loss = t * (jnp.log(jnp.maximum(t, 1e-12)) - lp)
+        if reduction == "mean":
+            return loss.mean()
+        if reduction == "batchmean":
+            return loss.sum() / lp.shape[0]
+        if reduction == "sum":
+            return loss.sum()
+        return loss
+
+    return apply(f, [input, label], name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    input, other, label = coerce(input), coerce(other), coerce(label)
+
+    def f(a, b, y):
+        loss = jnp.maximum(0.0, -y.astype(a.dtype) * (a - b) + margin)
+        if reduction == "mean":
+            return loss.mean()
+        if reduction == "sum":
+            return loss.sum()
+        return loss
+
+    return apply(f, [input, other, label], name="margin_ranking_loss")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    x1, x2 = coerce(x1), coerce(x2)
+
+    def f(a, b):
+        num = (a * b).sum(axis)
+        den = jnp.sqrt(jnp.square(a).sum(axis)) * jnp.sqrt(jnp.square(b).sum(axis))
+        return num / jnp.maximum(den, eps)
+
+    return apply(f, [x1, x2], name="cosine_similarity")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    logit, label = coerce(logit), coerce(label)
+    ins = [logit, label] + ([coerce(normalizer)] if normalizer is not None else [])
+
+    def f(z, y, *n):
+        y = y.astype(z.dtype)
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if n:
+            loss = loss / n[0]
+        if reduction == "mean":
+            return loss.mean()
+        if reduction == "sum":
+            return loss.sum()
+        return loss
+
+    return apply(f, ins, name="sigmoid_focal_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    input, label = coerce(input), coerce(label)
+
+    def f(a, y):
+        y = y.astype(a.dtype)
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        if reduction == "mean":
+            return loss.mean()
+        if reduction == "sum":
+            return loss.sum()
+        return loss
+
+    return apply(f, [input, label], name="hinge_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6, swap=False, reduction="mean", name=None):
+    input, positive, negative = coerce(input), coerce(positive), coerce(negative)
+
+    def f(a, pos, neg):
+        def dist(u, v):
+            return jnp.sum(jnp.abs(u - v) ** p, axis=-1) ** (1.0 / p)
+
+        d_ap = dist(a, pos)
+        d_an = dist(a, neg)
+        if swap:
+            d_pn = dist(pos, neg)
+            d_an = jnp.minimum(d_an, d_pn)
+        loss = jnp.maximum(d_ap - d_an + margin, 0.0)
+        if reduction == "mean":
+            return loss.mean()
+        if reduction == "sum":
+            return loss.sum()
+        return loss
+
+    return apply(f, [input, positive, negative], name="triplet_margin_loss")
+
+
+# ---------------------------------------------------------------------------
+# attention (routes to pallas flash attention)
+# ---------------------------------------------------------------------------
+
+
+def scaled_dot_product_attention(
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None
+):
+    """Inputs [batch, seq, heads, head_dim] (paddle convention)."""
+    from ...ops.flash_attention import scaled_dot_product_attention as _sdpa
+
+    return _sdpa(query, key, value, attn_mask, dropout_p, is_causal, training)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False, name=None):
+    out = scaled_dot_product_attention(query, key, value, None, dropout, causal)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = coerce(x)
+    k = _tuplize(kernel_sizes, 2)
+    s = _tuplize(strides, 2)
+    d = _tuplize(dilations, 2)
+    p = _tuplize(paddings, 2)
+
+    def f(a):
+        n, c, h, w = a.shape
+        a_p = jnp.pad(a, [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
+        oh = (h + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = (w + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        cols = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                patch = a_p[:, :, i * d[0] : i * d[0] + oh * s[0] : s[0], j * d[1] : j * d[1] + ow * s[1] : s[1]]
+                cols.append(patch.reshape(n, c, -1))
+        return jnp.stack(cols, 2).reshape(n, c * k[0] * k[1], -1)
+
+    return apply(f, [x], name="unfold")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    x = coerce(x)
+
+    def f(a):
+        n, c, h, w = a.shape
+        if size is not None:
+            oh, ow = _tuplize(size, 2)
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else (scale_factor, scale_factor)
+            oh, ow = int(h * sf[0]), int(w * sf[1])
+        method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+        a2 = jnp.moveaxis(a, 1, -1)
+        out = jax.image.resize(a2, (n, oh, ow, c), method=method)
+        return jnp.moveaxis(out, -1, 1)
+
+    return apply(f, [x], name="interpolate")
+
+
+upsample = interpolate
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    x = coerce(x)
+    r = upscale_factor
+
+    def f(a):
+        n, c, h, w = a.shape
+        a2 = a.reshape(n, c // (r * r), r, r, h, w)
+        a2 = jnp.transpose(a2, (0, 1, 4, 2, 5, 3))
+        return a2.reshape(n, c // (r * r), h * r, w * r)
+
+    return apply(f, [x], name="pixel_shuffle")
+
+
+def pad(x, pad_width, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ...ops.manipulation import pad as _pad
+
+    return _pad(x, pad_width, mode, value, data_format)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    x = coerce(x)
+
+    def f(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        a2 = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([a2[:, 1:, :fold], jnp.zeros_like(a2[:, :1, :fold])], 1)
+        right = jnp.concatenate([jnp.zeros_like(a2[:, :1, fold : 2 * fold]), a2[:, :-1, fold : 2 * fold]], 1)
+        rest = a2[:, :, 2 * fold :]
+        return jnp.concatenate([left, right, rest], 2).reshape(nt, c, h, w)
+
+    return apply(f, [x], name="temporal_shift")
+
+
+def linear_fp8(*a, **k):
+    raise NotImplementedError("fp8 path lands with quantization support")

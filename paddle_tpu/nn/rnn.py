@@ -1,0 +1,305 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py).
+
+TPU-native: the whole sequence recurrence is a single op built on lax.scan,
+so XLA compiles one fused loop (the reference dispatches per-timestep cuDNN
+kernels); autograd flows through scan's built-in VJP.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.dispatch import apply, coerce
+from ..tensor import Tensor
+from . import initializer as I
+from .layer import Layer
+
+
+def _uniform_init(k):
+    return I.Uniform(-k, k)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        num_dirs = 2 if self.bidirectional else 1
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}[mode]
+        k = 1.0 / math.sqrt(hidden_size)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(num_dirs):
+                in_sz = input_size if layer == 0 else hidden_size * num_dirs
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                w_ih = self.create_parameter([gate_mult * hidden_size, in_sz], attr=weight_ih_attr, default_initializer=_uniform_init(k))
+                w_hh = self.create_parameter([gate_mult * hidden_size, hidden_size], attr=weight_hh_attr, default_initializer=_uniform_init(k))
+                b_ih = self.create_parameter([gate_mult * hidden_size], attr=bias_ih_attr, is_bias=True, default_initializer=_uniform_init(k))
+                b_hh = self.create_parameter([gate_mult * hidden_size], attr=bias_hh_attr, is_bias=True, default_initializer=_uniform_init(k))
+                self.add_parameter(f"weight_ih{sfx}", w_ih)
+                self.add_parameter(f"weight_hh{sfx}", w_hh)
+                self.add_parameter(f"bias_ih{sfx}", b_ih)
+                self.add_parameter(f"bias_hh{sfx}", b_hh)
+                self._all_weights.append((f"weight_ih{sfx}", f"weight_hh{sfx}", f"bias_ih{sfx}", f"bias_hh{sfx}"))
+
+    def _cell(self, mode):
+        hs = self.hidden_size
+
+        if mode == "LSTM":
+            def step(carry, x_t, w_ih, w_hh, b_ih, b_hh):
+                h, c = carry
+                gates = x_t @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+                g = jnp.tanh(g)
+                c_new = f * c + i * g
+                h_new = o * jnp.tanh(c_new)
+                return (h_new, c_new), h_new
+        elif mode == "GRU":
+            def step(carry, x_t, w_ih, w_hh, b_ih, b_hh):
+                (h,) = carry
+                gi = x_t @ w_ih.T + b_ih
+                gh = h @ w_hh.T + b_hh
+                i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+                h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(i_r + h_r)
+                z = jax.nn.sigmoid(i_z + h_z)
+                n = jnp.tanh(i_n + r * h_n)
+                h_new = (1 - z) * n + z * h
+                return (h_new,), h_new
+        else:
+            act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+
+            def step(carry, x_t, w_ih, w_hh, b_ih, b_hh):
+                (h,) = carry
+                h_new = act(x_t @ w_ih.T + h @ w_hh.T + b_ih + b_hh)
+                return (h_new,), h_new
+
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        inputs = coerce(inputs)
+        num_dirs = 2 if self.bidirectional else 1
+        is_lstm = self.mode == "LSTM"
+        batch_axis = 1 if self.time_major else 0
+
+        weights = []
+        for names in self._all_weights:
+            weights.extend(self._parameters[n] for n in names)
+
+        b = inputs.shape[batch_axis]
+        hs = self.hidden_size
+        nl = self.num_layers
+
+        init_given = initial_states is not None
+        ins = [inputs] + weights
+        if init_given:
+            if is_lstm:
+                h0, c0 = initial_states
+                ins += [coerce(h0), coerce(c0)]
+            else:
+                ins.append(coerce(initial_states))
+
+        mode = self.mode
+        time_major = self.time_major
+        step_fn = self._cell(mode)
+
+        def f(x, *rest):
+            if init_given:
+                if is_lstm:
+                    wts, (h0_, c0_) = rest[:-2], rest[-2:]
+                else:
+                    wts, h0_ = rest[:-1], rest[-1]
+                    c0_ = None
+            else:
+                wts = rest
+                h0_ = jnp.zeros((nl * num_dirs, b, hs), x.dtype)
+                c0_ = jnp.zeros((nl * num_dirs, b, hs), x.dtype) if is_lstm else None
+
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # [seq, batch, feat]
+
+            out = x
+            final_h = []
+            final_c = []
+            for layer in range(nl):
+                dir_outs = []
+                for d in range(num_dirs):
+                    idx = (layer * num_dirs + d) * 4
+                    w_ih, w_hh, b_ih, b_hh = wts[idx : idx + 4]
+                    sid = layer * num_dirs + d
+                    h_init = h0_[sid]
+                    carry0 = (h_init, c0_[sid]) if is_lstm else (h_init,)
+                    seq = jnp.flip(out, 0) if d == 1 else out
+
+                    def scan_step(carry, x_t, _w_ih=w_ih, _w_hh=w_hh, _b_ih=b_ih, _b_hh=b_hh):
+                        return step_fn(carry, x_t, _w_ih, _w_hh, _b_ih, _b_hh)
+
+                    carry_f, ys = lax.scan(scan_step, carry0, seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    dir_outs.append(ys)
+                    final_h.append(carry_f[0])
+                    if is_lstm:
+                        final_c.append(carry_f[1])
+                out = jnp.concatenate(dir_outs, -1) if num_dirs == 2 else dir_outs[0]
+            fh = jnp.stack(final_h, 0)
+            if not time_major:
+                out = jnp.swapaxes(out, 0, 1)
+            if is_lstm:
+                return out, fh, jnp.stack(final_c, 0)
+            return out, fh
+
+        if is_lstm:
+            out, fh, fc = apply(f, ins, multi=True, name=mode.lower())
+            return out, (fh, fc)
+        out, fh = apply(f, ins, multi=True, name=mode.lower())
+        return out, fh
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward", time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction, time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction, time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction, time_major, dropout, **kwargs)
+
+
+class LSTMCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        k = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size], attr=weight_ih_attr, default_initializer=_uniform_init(k))
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size], attr=weight_hh_attr, default_initializer=_uniform_init(k))
+        self.bias_ih = self.create_parameter([4 * hidden_size], attr=bias_ih_attr, is_bias=True, default_initializer=_uniform_init(k))
+        self.bias_hh = self.create_parameter([4 * hidden_size], attr=bias_hh_attr, is_bias=True, default_initializer=_uniform_init(k))
+
+    def forward(self, inputs, states=None):
+        inputs = coerce(inputs)
+        if states is None:
+            from .. import ops as _ops
+
+            b = inputs.shape[0]
+            states = (
+                _ops.zeros([b, self.hidden_size], inputs.dtype),
+                _ops.zeros([b, self.hidden_size], inputs.dtype),
+            )
+        h, c = states
+        ins = [inputs, coerce(h), coerce(c), self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh]
+
+        def f(x, h, c, w_ih, w_hh, b_ih, b_hh):
+            gates = x @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+            i, fg, g, o = jnp.split(gates, 4, axis=-1)
+            i, fg, o = jax.nn.sigmoid(i), jax.nn.sigmoid(fg), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = fg * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+
+        h_new, c_new = apply(f, ins, multi=True, name="lstm_cell")
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        k = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size], attr=weight_ih_attr, default_initializer=_uniform_init(k))
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size], attr=weight_hh_attr, default_initializer=_uniform_init(k))
+        self.bias_ih = self.create_parameter([3 * hidden_size], attr=bias_ih_attr, is_bias=True, default_initializer=_uniform_init(k))
+        self.bias_hh = self.create_parameter([3 * hidden_size], attr=bias_hh_attr, is_bias=True, default_initializer=_uniform_init(k))
+
+    def forward(self, inputs, states=None):
+        inputs = coerce(inputs)
+        if states is None:
+            from .. import ops as _ops
+
+            states = _ops.zeros([inputs.shape[0], self.hidden_size], inputs.dtype)
+        ins = [inputs, coerce(states), self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh]
+
+        def f(x, h, w_ih, w_hh, b_ih, b_hh):
+            gi = x @ w_ih.T + b_ih
+            gh = h @ w_hh.T + b_hh
+            i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+            h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(i_r + h_r)
+            z = jax.nn.sigmoid(i_z + h_z)
+            n = jnp.tanh(i_n + r * h_n)
+            return (1 - z) * n + z * h
+
+        h_new = apply(f, ins, name="gru_cell")
+        return h_new, h_new
+
+
+class SimpleRNNCell(Layer):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.activation = activation
+        k = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter([hidden_size, input_size], attr=weight_ih_attr, default_initializer=_uniform_init(k))
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size], attr=weight_hh_attr, default_initializer=_uniform_init(k))
+        self.bias_ih = self.create_parameter([hidden_size], attr=bias_ih_attr, is_bias=True, default_initializer=_uniform_init(k))
+        self.bias_hh = self.create_parameter([hidden_size], attr=bias_hh_attr, is_bias=True, default_initializer=_uniform_init(k))
+
+    def forward(self, inputs, states=None):
+        inputs = coerce(inputs)
+        if states is None:
+            from .. import ops as _ops
+
+            states = _ops.zeros([inputs.shape[0], self.hidden_size], inputs.dtype)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        ins = [inputs, coerce(states), self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh]
+        h_new = apply(
+            lambda x, h, wi, wh, bi, bh: act(x @ wi.T + h @ wh.T + bi + bh), ins, name="rnn_cell"
+        )
+        return h_new, h_new
+
+
+class RNN(Layer):
+    """Wraps a cell into a recurrence (paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        inputs = coerce(inputs)
+        axis = 0 if self.time_major else 1
+        steps = inputs.shape[axis]
+        rng = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        outs = []
+        states = initial_states
+        from .. import ops as _ops
+
+        for t in rng:
+            x_t = _ops.slice(inputs, [axis], [t], [t + 1]).squeeze([axis])
+            y, states = self.cell(x_t, states)
+            outs.append(y)
+        if self.is_reverse:
+            outs = outs[::-1]
+        out = _ops.stack(outs, axis=axis)
+        return out, states

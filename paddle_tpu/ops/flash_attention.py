@@ -1,0 +1,253 @@
+"""Flash attention — TPU-native (reference capability:
+paddle/phi/kernels/gpu/flash_attn_kernel.cu wrapping the FlashAttention CUDA
+library; here a Pallas TPU kernel + an XLA blockwise fallback).
+
+Layout convention follows the reference API: [batch, seq, num_heads, head_dim].
+
+Design (see /opt/skills/guides/pallas_guide.md):
+- forward: online-softmax blockwise kernel; grid over (batch*heads, q blocks);
+  K/V streamed through VMEM; causal masking applied per block.
+- backward: blockwise recompute (flash-attention-2 style) expressed in JAX —
+  XLA fuses it well on TPU; a hand-written Pallas backward is a later
+  optimization.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework import core as _core
+from ..tensor import Tensor
+from .dispatch import apply, coerce
+
+_NEG_INF = -1e30
+
+
+def _on_tpu():
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, scale, block_q, block_k, seq_len):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[...].astype(jnp.float32) * scale  # [block_q, d]
+
+    m = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+
+    num_k_blocks = seq_len // block_k
+    q_start = qi * block_q
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            q_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_ids = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + p.sum(-1)
+        acc_new = acc * alpha[:, None] + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    upper = (q_start + block_q + block_k - 1) // block_k if causal else num_k_blocks
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m, l, acc))
+    o_ref[...] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _pallas_flash_forward(q, k, v, causal, scale, block_q=256, block_k=256):
+    """q,k,v: [bh, seq, d] — returns [bh, seq, d]."""
+    from jax.experimental import pallas as pl
+
+    bh, seq_len, d = q.shape
+    block_q = min(block_q, seq_len)
+    block_k = min(block_k, seq_len)
+    grid = (bh, seq_len // block_q)
+
+    kernel = functools.partial(
+        _flash_fwd_kernel,
+        causal=causal,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
+        seq_len=seq_len,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, seq_len, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, seq_len, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Blockwise XLA fallback (O(seq) memory via scan + checkpoint)
+# ---------------------------------------------------------------------------
+
+
+def _blockwise_attention(q, k, v, mask, causal, scale, block_k=512):
+    """q: [b, h, sq, d]; k,v: [b, h, sk, d]; mask broadcastable [b, h, sq, sk]."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if sk <= block_k or sk % block_k != 0:
+        return _dense_attention(q, k, v, mask, causal, scale)
+
+    qf = q.astype(jnp.float32) * scale
+    nblocks = sk // block_k
+
+    def body(carry, ki):
+        m, l, acc = carry
+        ks = lax.dynamic_slice_in_dim(k, ki * block_k, block_k, axis=2).astype(jnp.float32)
+        vs = lax.dynamic_slice_in_dim(v, ki * block_k, block_k, axis=2).astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, ks)
+        if causal:
+            q_ids = jax.lax.broadcasted_iota(jnp.int32, (sq, block_k), 0)
+            k_ids = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (sq, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+        if mask is not None:
+            msk = lax.dynamic_slice_in_dim(mask, ki * block_k, block_k, axis=-1)
+            s = s + msk.astype(s.dtype)
+        m_new = jnp.maximum(m, s.max(-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + p.sum(-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vs)
+        return (m_new, l_new, acc_new), None
+
+    init = (
+        jnp.full((b, h, sq), _NEG_INF, jnp.float32),
+        jnp.zeros((b, h, sq), jnp.float32),
+        jnp.zeros((b, h, sq, d), jnp.float32),
+    )
+    (m, l, acc), _ = lax.scan(jax.checkpoint(body), init, jnp.arange(nblocks))
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def _dense_attention(q, k, v, mask, causal, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32) * scale, k.astype(jnp.float32))
+    sq, sk = q.shape[2], k.shape[2]
+    if causal:
+        q_ids = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_ids = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(q_ids >= k_ids - (sk - sq), s, _NEG_INF)
+    if mask is not None:
+        s = s + mask.astype(s.dtype)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# public entry — jax-level (arrays in, arrays out; custom_vjp around pallas)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention_core(q, k, v, causal, scale):
+    return _flash_fwd_impl(q, k, v, causal, scale)
+
+
+def _flash_fwd_impl(q, k, v, causal, scale):
+    """q,k,v: [b, h, s, d]."""
+    b, h, s, d = q.shape
+    use_pallas = (
+        _on_tpu()
+        and s % 128 == 0
+        and d <= 256
+        and q.shape == k.shape
+    )
+    if use_pallas:
+        qf = q.reshape(b * h, s, d)
+        kf = k.reshape(b * h, s, d)
+        vf = v.reshape(b * h, s, d)
+        out = _pallas_flash_forward(qf, kf, vf, causal, scale)
+        return out.reshape(b, h, s, d)
+    return _blockwise_attention(q, k, v, None, causal, scale)
+
+
+def _flash_fwd_rule(q, k, v, causal, scale):
+    out = _flash_fwd_impl(q, k, v, causal, scale)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, scale, res, g):
+    q, k, v = res
+    # flash-2-style recompute backward, expressed for XLA
+    _, vjp = jax.vjp(lambda q_, k_, v_: _blockwise_attention(q_, k_, v_, None, causal, scale), q, k, v)
+    return vjp(g)
+
+
+_flash_attention_core.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def sdpa_array(q, k, v, mask=None, causal=False, scale=None):
+    """Array-level SDPA used by models and by the Tensor-level op below.
+
+    q,k,v: [batch, seq, heads, dim] → out [batch, seq, heads, dim].
+    """
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    # grouped-query attention: expand kv heads if fewer than q heads
+    hq, hk = qt.shape[1], kt.shape[1]
+    if hk != hq:
+        rep = hq // hk
+        kt = jnp.repeat(kt, rep, axis=1)
+        vt = jnp.repeat(vt, rep, axis=1)
+    if mask is None:
+        out = _flash_attention_core(qt, kt, vt, causal, scale)
+    else:
+        out = _dense_attention(qt, kt, vt, mask, causal, scale)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def scaled_dot_product_attention(
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True
+):
+    query, key, value = coerce(query), coerce(key), coerce(value)
+    ins = [query, key, value]
+    has_mask = attn_mask is not None
+    if has_mask:
+        mask = coerce(attn_mask)
+        if mask.dtype == "bool":
+            from . import cast as _  # noqa
+
+            mask = apply(
+                lambda m: jnp.where(m, 0.0, _NEG_INF).astype(jnp.float32), [mask]
+            )
+        ins.append(mask)
+
+    def f(q, k, v, *m):
+        return sdpa_array(q, k, v, m[0] if m else None, is_causal)
+
+    out = apply(f, ins, name="flash_attention")
+    if dropout_p > 0.0 and training:
+        from ..nn.functional import dropout as _dropout
+
+        out = _dropout(out, dropout_p, training=training)
+    return out

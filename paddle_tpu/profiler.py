@@ -1,1 +1,180 @@
-# placeholder during bring-up
+"""paddle.profiler (reference: python/paddle/profiler/profiler.py over the
+native CUPTI tracer) — TPU-native: wraps jax.profiler (XPlane/libtpu) with
+the reference's API shape (Profiler, RecordEvent, make_scheduler,
+export_chrome_tracing)."""
+
+from __future__ import annotations
+
+import contextlib
+import enum
+import os
+import time
+
+import jax
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
+    def scheduler(step):
+        s = step - skip_first
+        if s < 0:
+            return ProfilerState.CLOSED
+        period = closed + ready + record
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        prof._export_dir = dir_name
+
+    return handler
+
+
+def export_protobuf(dir_name, worker_name=None):
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+class RecordEvent:
+    """Host-span annotation; shows up in the XPlane host timeline
+    (reference: platform::RecordEvent)."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ctx = None
+
+    def begin(self):
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+
+    def end(self):
+        if self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+class Profiler:
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None, record_shapes=False, profile_memory=False, timer_only=False, with_flops=False):
+        self._scheduler = scheduler if callable(scheduler) else None
+        if isinstance(scheduler, (tuple, list)):
+            lo, hi = scheduler
+            self._scheduler = lambda step: (
+                ProfilerState.RECORD if lo <= step < hi else ProfilerState.CLOSED
+            )
+        self._on_trace_ready = on_trace_ready
+        self._export_dir = os.path.join(os.getcwd(), "profiler_log")
+        self._running = False
+        self._step = 0
+        self._timer_only = timer_only
+        self._step_times = []
+        self._last = None
+
+    def start(self):
+        self._step = 0
+        if not self._timer_only:
+            state = self._scheduler(self._step) if self._scheduler else ProfilerState.RECORD
+            if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+                self._begin_trace()
+        self._last = time.perf_counter()
+
+    def _begin_trace(self):
+        if not self._running:
+            if self._on_trace_ready is not None:
+                self._on_trace_ready(self)
+            os.makedirs(self._export_dir, exist_ok=True)
+            try:
+                jax.profiler.start_trace(self._export_dir)
+                self._running = True
+            except Exception:
+                self._running = False
+
+    def _end_trace(self):
+        if self._running:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._running = False
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last is not None:
+            self._step_times.append(now - self._last)
+        self._last = now
+        self._step += 1
+        if self._timer_only or self._scheduler is None:
+            return
+        state = self._scheduler(self._step)
+        if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._begin_trace()
+        else:
+            self._end_trace()
+
+    def stop(self):
+        self._end_trace()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def export(self, path=None, format="json"):
+        pass
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
+        if self._step_times:
+            avg = sum(self._step_times) / len(self._step_times)
+            print(f"steps: {len(self._step_times)}  avg step time: {avg*1000:.3f} ms")
+
+    def step_info(self, unit=None):
+        if self._step_times:
+            return f"step time: {self._step_times[-1]*1000:.3f} ms"
+        return ""
+
+
+@contextlib.contextmanager
+def profile(dir_name="profiler_log"):
+    os.makedirs(dir_name, exist_ok=True)
+    jax.profiler.start_trace(dir_name)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def load_profiler_result(path):
+    raise NotImplementedError("use TensorBoard / xprof to view XPlane traces")

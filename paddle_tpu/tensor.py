@@ -70,6 +70,7 @@ class Tensor:
         self.name = name
         self._trainable = True
         self._hooks = None
+        _core.mark_born_if_tracing(self)
 
     # ------------------------------------------------------------------
     # trace-aware payload access
@@ -124,6 +125,7 @@ class Tensor:
         self.name = None
         self._trainable = True
         self._hooks = None
+        _core.mark_born_if_tracing(self)
         return self
 
     # ------------------------------------------------------------------

@@ -70,12 +70,18 @@ def convert_dtype(dtype):
     return name
 
 
+_X64_DEMOTE = {"int64": jnp.int32, "uint64": jnp.uint32, "float64": jnp.float32}
+
+
 def to_jax_dtype(dtype):
     if dtype is None:
         return None
-    if isinstance(dtype, str) or isinstance(dtype, type):
-        return _STR2DTYPE[convert_dtype(dtype)]
-    return jnp.dtype(dtype) if not hasattr(dtype, "dtype") else dtype
+    name = convert_dtype(dtype)
+    # TPU-native: 32-bit integers/floats by default (x64 disabled) — wide
+    # dtypes demote silently, mirroring jax's canonical dtype policy.
+    if not jax.config.jax_enable_x64 and name in _X64_DEMOTE:
+        return _X64_DEMOTE[name]
+    return _STR2DTYPE[name]
 
 
 def is_floating_dtype(dtype) -> bool:

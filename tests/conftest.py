@@ -1,0 +1,43 @@
+"""Test harness (mirrors the reference's test strategy, SURVEY.md §4):
+CPU backend with 8 virtual devices so ALL distributed logic runs with no TPU
+(the reference's Gloo/CustomCPU fixture pattern)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+# the axon sitecustomize force-registers the TPU backend; override to CPU
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seeded():
+    import paddle_tpu as paddle
+
+    paddle.seed(1234)
+    np.random.seed(1234)
+    yield
+
+
+def finite_difference_grad(fn, x, eps=1e-3):
+    """Numeric gradient of scalar fn at numpy array x (OpTest check_grad)."""
+    x = np.asarray(x, np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy()
+        xp[idx] += eps
+        xm = x.copy()
+        xm[idx] -= eps
+        g[idx] = (fn(xp.astype(np.float32)) - fn(xm.astype(np.float32))) / (2 * eps)
+        it.iternext()
+    return g

@@ -1,0 +1,148 @@
+"""Autograd engine tests (reference: test/legacy_test/test_autograd_*)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(arr, rg=False):
+    return paddle.to_tensor(np.asarray(arr, np.float32), stop_gradient=not rg)
+
+
+class TestBackward:
+    def test_chain(self):
+        x = t(2.0, rg=True)
+        y = x * x * x
+        y.backward()
+        assert float(x.grad.numpy()) == pytest.approx(12.0)
+
+    def test_multi_use(self):
+        x = t(3.0, rg=True)
+        y = x * x + x * 2
+        y.backward()
+        assert float(x.grad.numpy()) == pytest.approx(8.0)
+
+    def test_stop_gradient(self):
+        x = t(1.0, rg=True)
+        y = t(1.0)  # stop_gradient=True
+        z = x * y
+        z.backward()
+        assert x.grad is not None
+        assert y.grad is None
+
+    def test_detach(self):
+        x = t(2.0, rg=True)
+        y = (x * x).detach()
+        z = y * x
+        z.backward()
+        assert float(x.grad.numpy()) == pytest.approx(4.0)  # y treated const
+
+    def test_retain_graph(self):
+        x = t(2.0, rg=True)
+        y = x * x
+        y.backward(retain_graph=True)
+        y.backward()
+        assert float(x.grad.numpy()) == pytest.approx(8.0)
+
+    def test_second_backward_raises(self):
+        x = t(2.0, rg=True)
+        y = x * x
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_no_grad(self):
+        x = t(2.0, rg=True)
+        with paddle.no_grad():
+            y = x * x
+        assert y._grad_node is None
+
+    def test_backward_nonscalar_uses_ones(self):
+        x = t(np.ones(4), rg=True)
+        (x * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.full(4, 3.0))
+
+
+class TestGradAPI:
+    def test_grad_basic(self):
+        x = t(2.0, rg=True)
+        y = x * x
+        (g,) = paddle.grad(y, x)
+        assert float(g.numpy()) == pytest.approx(4.0)
+        assert x.grad is None  # paddle.grad must not touch .grad
+
+    def test_double_grad(self):
+        x = t(2.0, rg=True)
+        y = x * x * x
+        (g1,) = paddle.grad(y, x, create_graph=True)
+        (g2,) = paddle.grad(g1, x, create_graph=True)
+        (g3,) = paddle.grad(g2, x)
+        assert float(g1.numpy()) == pytest.approx(12.0)
+        assert float(g2.numpy()) == pytest.approx(12.0)
+        assert float(g3.numpy()) == pytest.approx(6.0)
+
+    def test_grad_unused(self):
+        x = t(1.0, rg=True)
+        z = t(1.0, rg=True)
+        y = x * 2
+        with pytest.raises(RuntimeError):
+            paddle.grad(y, [x, z], retain_graph=True)
+        gs = paddle.grad(y, [x, z], allow_unused=True)
+        assert gs[1] is None
+
+    def test_grad_with_grad_outputs(self):
+        x = t(np.ones(3), rg=True)
+        y = x * 2
+        (g,) = paddle.grad(y, x, grad_outputs=t(np.array([1.0, 2.0, 3.0])))
+        np.testing.assert_allclose(g.numpy(), [2.0, 4.0, 6.0])
+
+
+class TestHooks:
+    def test_tensor_hook(self):
+        x = t(1.0, rg=True)
+        x.register_hook(lambda g: g * 5)
+        (x * 2).backward()
+        assert float(x.grad.numpy()) == pytest.approx(10.0)
+
+    def test_hook_remove(self):
+        x = t(1.0, rg=True)
+        h = x.register_hook(lambda g: g * 5)
+        h.remove()
+        (x * 2).backward()
+        assert float(x.grad.numpy()) == pytest.approx(2.0)
+
+
+class TestPyLayer:
+    def test_pylayer_fwd_bwd(self):
+        class Square(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, gy):
+                (x,) = ctx.saved_tensor
+                return gy * 2 * x
+
+        x = t(3.0, rg=True)
+        y = Square.apply(x)
+        y.backward()
+        assert float(y.numpy()) == pytest.approx(9.0)
+        assert float(x.grad.numpy()) == pytest.approx(6.0)
+
+    def test_pylayer_multi_output(self):
+        class Two(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x * 2, x * 3
+
+            @staticmethod
+            def backward(ctx, g1, g2):
+                return g1 * 2 + g2 * 3
+
+        x = t(1.0, rg=True)
+        a, b = Two.apply(x)
+        (a + b).backward()
+        assert float(x.grad.numpy()) == pytest.approx(5.0)

@@ -1,0 +1,154 @@
+"""to_static step-compiler tests (reference: dy2static test suite pattern)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def t(arr, rg=False):
+    return paddle.to_tensor(np.asarray(arr, np.float32), stop_gradient=not rg)
+
+
+class TestToStatic:
+    def test_pure_fn(self):
+        @paddle.jit.to_static
+        def f(x, y):
+            return x * 2 + y
+
+        out = f(t(np.ones(3)), t(np.full(3, 5.0)))
+        np.testing.assert_allclose(out.numpy(), np.full(3, 7.0))
+        out2 = f(t(np.zeros(3)), t(np.ones(3)))
+        np.testing.assert_allclose(out2.numpy(), np.ones(3))
+
+    def test_param_read(self):
+        w = t(np.full(2, 3.0))
+
+        @paddle.jit.to_static
+        def f(x):
+            return x * w
+
+        np.testing.assert_allclose(f(t(np.ones(2))).numpy(), [3.0, 3.0])
+        # param update must be visible without retrace
+        w._data = w._data * 2
+        np.testing.assert_allclose(f(t(np.ones(2))).numpy(), [6.0, 6.0])
+
+    def test_state_write(self):
+        acc = t(np.zeros(1))
+
+        @paddle.jit.to_static
+        def f(x):
+            acc._data = acc._data + x._data.sum()
+            return acc.clone()
+
+        f(t(np.ones(3)))
+        f(t(np.ones(3)))
+        np.testing.assert_allclose(acc.numpy(), [6.0])
+
+    def test_multiple_signatures(self):
+        @paddle.jit.to_static
+        def f(x):
+            return x.sum()
+
+        assert float(f(t(np.ones(3))).numpy()) == 3.0
+        assert float(f(t(np.ones((2, 2)))).numpy()) == 4.0
+        assert len(f._cache) == 2
+
+    def test_structured_io(self):
+        @paddle.jit.to_static
+        def f(batch):
+            return {"out": batch["a"] + batch["b"], "aux": [batch["a"] * 2]}
+
+        out = f({"a": t(np.ones(2)), "b": t(np.full(2, 2.0))})
+        np.testing.assert_allclose(out["out"].numpy(), [3.0, 3.0])
+        np.testing.assert_allclose(out["aux"][0].numpy(), [2.0, 2.0])
+
+    def test_train_step_compiled_matches_eager(self):
+        paddle.seed(0)
+        m1 = nn.Linear(4, 2)
+        m2 = nn.Linear(4, 2)
+        m2.set_state_dict(m1.state_dict())
+        o1 = paddle.optimizer.SGD(learning_rate=0.1, parameters=m1.parameters())
+        o2 = paddle.optimizer.SGD(learning_rate=0.1, parameters=m2.parameters())
+        lossfn = nn.MSELoss()
+
+        @paddle.jit.to_static
+        def step2(x, y):
+            loss = lossfn(m2(x), y)
+            loss.backward()
+            o2.step()
+            o2.clear_grad()
+            return loss
+
+        for i in range(5):
+            x = np.random.rand(8, 4).astype(np.float32)
+            y = np.random.rand(8, 2).astype(np.float32)
+            loss1 = lossfn(m1(t(x)), t(y))
+            loss1.backward()
+            o1.step()
+            o1.clear_grad()
+            loss2 = step2(t(x), t(y))
+            np.testing.assert_allclose(
+                float(loss1.numpy()), float(loss2.numpy()), rtol=1e-4
+            )
+        np.testing.assert_allclose(
+            m1.weight.numpy(), m2.weight.numpy(), rtol=1e-4, atol=1e-5
+        )
+
+    def test_rng_threaded_not_baked(self):
+        @paddle.jit.to_static
+        def f(x):
+            return x + paddle.randn(x.shape)
+
+        a = f(t(np.zeros(4))).numpy()
+        b = f(t(np.zeros(4))).numpy()
+        assert not np.allclose(a, b), "RNG was baked as a constant"
+
+    def test_dropout_varies_under_jit(self):
+        import paddle_tpu.nn.functional as F
+
+        @paddle.jit.to_static
+        def f(x):
+            return F.dropout(x, 0.5, training=True)
+
+        a = f(t(np.ones(100))).numpy()
+        b = f(t(np.ones(100))).numpy()
+        assert not np.array_equal(a, b)
+
+    def test_lr_schedule_visible_in_compiled_step(self):
+        w = t(np.array([0.0]), rg=True)
+        sched = paddle.optimizer.lr.StepDecay(1.0, step_size=1, gamma=0.1)
+        opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[w])
+
+        @paddle.jit.to_static
+        def step():
+            (w * 1.0).sum().backward()
+            opt.step()
+            opt.clear_grad()
+
+        step()
+        np.testing.assert_allclose(w.numpy(), [-1.0], rtol=1e-5)
+        sched.step()
+        step()
+        np.testing.assert_allclose(w.numpy(), [-1.1], rtol=1e-5)
+
+    def test_batchnorm_stats_updated_under_jit(self):
+        bn = nn.BatchNorm1D(3)
+
+        @paddle.jit.to_static
+        def f(x):
+            return bn(x)
+
+        before = bn._mean.numpy().copy()
+        f(t(np.random.rand(8, 3) * 10))
+        after = bn._mean.numpy()
+        assert not np.allclose(before, after)
+
+    def test_numpy_inside_trace_raises(self):
+        @paddle.jit.to_static
+        def f(x):
+            return float(x.numpy().sum())
+
+        with pytest.raises(Exception):
+            f(t(np.ones(2)))

@@ -1,0 +1,281 @@
+"""nn layers vs numpy compositions (reference: test/legacy_test nn tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def t(arr, rg=False):
+    return paddle.to_tensor(np.asarray(arr, np.float32), stop_gradient=not rg)
+
+
+class TestLayerLifecycle:
+    def test_parameters_and_state_dict(self):
+        layer = nn.Linear(4, 3)
+        names = dict(layer.named_parameters())
+        assert set(names) == {"weight", "bias"}
+        sd = layer.state_dict()
+        assert sd["weight"].shape == [4, 3]
+
+        l2 = nn.Linear(4, 3)
+        l2.set_state_dict(sd)
+        np.testing.assert_array_equal(l2.weight.numpy(), layer.weight.numpy())
+
+    def test_nested_state_dict(self):
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        sd = model.state_dict()
+        assert "0.weight" in sd and "2.bias" in sd
+
+    def test_train_eval_mode(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        assert m.training
+        m.eval()
+        assert not m[1].training
+        m.train()
+        assert m[1].training
+
+    def test_hooks(self):
+        layer = nn.Linear(2, 2)
+        calls = []
+        layer.register_forward_post_hook(lambda l, i, o: calls.append(1))
+        layer(t(np.ones((1, 2))))
+        assert calls
+
+    def test_apply_and_to_dtype(self):
+        m = nn.Linear(3, 3)
+        m.to(dtype="bfloat16")
+        assert m.weight.dtype == "bfloat16"
+
+    def test_buffers(self):
+        bn = nn.BatchNorm1D(4)
+        assert "_mean" in dict(bn.named_buffers())
+        assert "_mean" in bn.state_dict()
+
+
+class TestFunctional:
+    def test_linear(self):
+        x = np.random.rand(2, 4).astype(np.float32)
+        w = np.random.rand(4, 3).astype(np.float32)
+        b = np.random.rand(3).astype(np.float32)
+        np.testing.assert_allclose(
+            F.linear(t(x), t(w), t(b)).numpy(), x @ w + b, rtol=1e-5
+        )
+
+    def test_activations(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(F.relu(t(x)).numpy(), np.maximum(x, 0), rtol=1e-6)
+        np.testing.assert_allclose(
+            F.sigmoid(t(x)).numpy(), 1 / (1 + np.exp(-x)), rtol=1e-5
+        )
+        sm = F.softmax(t(x), axis=-1).numpy()
+        e = np.exp(x - x.max(-1, keepdims=True))
+        np.testing.assert_allclose(sm, e / e.sum(-1, keepdims=True), rtol=1e-5)
+
+    def test_conv2d_vs_naive(self):
+        x = np.random.rand(1, 2, 5, 5).astype(np.float32)
+        w = np.random.rand(3, 2, 3, 3).astype(np.float32)
+        out = F.conv2d(t(x), t(w), padding=1).numpy()
+        assert out.shape == (1, 3, 5, 5)
+        # center pixel check vs direct correlation
+        ref = sum(
+            (x[0, c, 1:4, 1:4] * w[0, c]).sum() for c in range(2)
+        )
+        np.testing.assert_allclose(out[0, 0, 2, 2], ref, rtol=1e-4)
+
+    def test_conv2d_grad(self):
+        x = t(np.random.rand(1, 1, 4, 4), rg=True)
+        w = t(np.random.rand(2, 1, 3, 3), rg=True)
+        F.conv2d(x, w, padding=1).sum().backward()
+        assert x.grad is not None and w.grad is not None
+
+    def test_pools(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        mp = F.max_pool2d(t(x), 2, 2).numpy()
+        np.testing.assert_array_equal(mp[0, 0], [[5, 7], [13, 15]])
+        ap = F.avg_pool2d(t(x), 2, 2).numpy()
+        np.testing.assert_allclose(ap[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+    def test_adaptive_pool(self):
+        x = np.random.rand(1, 2, 6, 6).astype(np.float32)
+        out = F.adaptive_avg_pool2d(t(x), (2, 2)).numpy()
+        np.testing.assert_allclose(out[0, 0, 0, 0], x[0, 0, :3, :3].mean(), rtol=1e-5)
+
+    def test_layer_norm(self):
+        x = np.random.rand(2, 5).astype(np.float32)
+        out = F.layer_norm(t(x), 5).numpy()
+        ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+    def test_rms_norm(self):
+        x = np.random.rand(2, 8).astype(np.float32)
+        out = F.rms_norm(t(x)).numpy()
+        ref = x / np.sqrt((x * x).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+    def test_batch_norm_train_updates_stats(self):
+        bn = nn.BatchNorm2D(3)
+        x = t(np.random.rand(4, 3, 2, 2) * 5)
+        before = bn._mean.numpy().copy()
+        bn(x)
+        after = bn._mean.numpy()
+        assert not np.allclose(before, after)
+        bn.eval()
+        y = bn(x)
+        assert y.shape == [4, 3, 2, 2]
+
+    def test_dropout(self):
+        x = t(np.ones((100, 100)))
+        out = F.dropout(x, 0.5, training=True).numpy()
+        frac = (out == 0).mean()
+        assert 0.4 < frac < 0.6
+        kept = out[out != 0]
+        np.testing.assert_allclose(kept, 2.0, rtol=1e-5)
+        np.testing.assert_array_equal(F.dropout(x, 0.5, training=False).numpy(), x.numpy())
+
+    def test_embedding(self):
+        w = np.random.rand(10, 4).astype(np.float32)
+        idx = np.array([[1, 2], [3, 4]])
+        out = F.embedding(paddle.to_tensor(idx), t(w)).numpy()
+        np.testing.assert_allclose(out, w[idx], rtol=1e-6)
+
+    def test_embedding_grad_scatter(self):
+        w = t(np.zeros((5, 2)), rg=True)
+        idx = paddle.to_tensor(np.array([1, 1, 3]))
+        F.embedding(idx, w).sum().backward()
+        g = w.grad.numpy()
+        np.testing.assert_allclose(g[1], [2, 2])
+        np.testing.assert_allclose(g[3], [1, 1])
+        np.testing.assert_allclose(g[0], [0, 0])
+
+
+class TestLosses:
+    def test_cross_entropy(self):
+        logits = np.random.rand(4, 5).astype(np.float32)
+        labels = np.array([0, 2, 4, 1])
+        loss = F.cross_entropy(t(logits), paddle.to_tensor(labels)).numpy()
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(loss, ref, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.rand(4, 5).astype(np.float32)
+        labels = np.array([0, -100, 4, -100])
+        loss = F.cross_entropy(t(logits), paddle.to_tensor(labels), ignore_index=-100).numpy()
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[[0, 2], [0, 4]]).mean()
+        np.testing.assert_allclose(loss, ref, rtol=1e-5)
+
+    def test_cross_entropy_soft_label(self):
+        logits = np.random.rand(3, 4).astype(np.float32)
+        soft = np.random.dirichlet(np.ones(4), 3).astype(np.float32)
+        loss = F.cross_entropy(t(logits), t(soft), soft_label=True).numpy()
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        logp = np.log(e / e.sum(-1, keepdims=True))
+        np.testing.assert_allclose(loss, -(soft * logp).sum(-1).mean(), rtol=1e-5)
+
+    def test_mse_l1(self):
+        a = np.random.rand(3, 3).astype(np.float32)
+        b = np.random.rand(3, 3).astype(np.float32)
+        np.testing.assert_allclose(F.mse_loss(t(a), t(b)).numpy(), ((a - b) ** 2).mean(), rtol=1e-5)
+        np.testing.assert_allclose(F.l1_loss(t(a), t(b)).numpy(), np.abs(a - b).mean(), rtol=1e-5)
+
+    def test_bce_with_logits(self):
+        z = np.random.randn(6).astype(np.float32)
+        y = (np.random.rand(6) > 0.5).astype(np.float32)
+        loss = F.binary_cross_entropy_with_logits(t(z), t(y)).numpy()
+        p = 1 / (1 + np.exp(-z))
+        ref = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(loss, ref, rtol=1e-4)
+
+    def test_kl_div(self):
+        lp = np.log(np.random.dirichlet(np.ones(4), 2)).astype(np.float32)
+        tgt = np.random.dirichlet(np.ones(4), 2).astype(np.float32)
+        loss = F.kl_div(t(lp), t(tgt), reduction="sum").numpy()
+        ref = (tgt * (np.log(tgt) - lp)).sum()
+        np.testing.assert_allclose(loss, ref, rtol=1e-4)
+
+
+class TestAttention:
+    def test_sdpa_matches_dense(self):
+        b, s, h, d = 2, 16, 4, 8
+        q = np.random.randn(b, s, h, d).astype(np.float32)
+        k = np.random.randn(b, s, h, d).astype(np.float32)
+        v = np.random.randn(b, s, h, d).astype(np.float32)
+        out = F.scaled_dot_product_attention(t(q), t(k), t(v)).numpy()
+        # dense reference
+        qh = q.transpose(0, 2, 1, 3)
+        kh = k.transpose(0, 2, 1, 3)
+        vh = v.transpose(0, 2, 1, 3)
+        sc = qh @ kh.transpose(0, 1, 3, 2) / np.sqrt(d)
+        e = np.exp(sc - sc.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = (p @ vh).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+    def test_sdpa_causal(self):
+        b, s, h, d = 1, 8, 2, 4
+        q = np.random.randn(b, s, h, d).astype(np.float32)
+        out = F.scaled_dot_product_attention(t(q), t(q), t(q), is_causal=True).numpy()
+        qh = q.transpose(0, 2, 1, 3)
+        sc = qh @ qh.transpose(0, 1, 3, 2) / np.sqrt(d)
+        mask = np.triu(np.full((s, s), -1e30), 1)
+        sc = sc + mask
+        e = np.exp(sc - sc.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = (p @ qh).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+    def test_sdpa_grad(self):
+        q = t(np.random.randn(1, 8, 2, 4), rg=True)
+        F.scaled_dot_product_attention(q, q, q, is_causal=True).sum().backward()
+        assert q.grad is not None
+
+    def test_multihead_attention_layer(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = t(np.random.rand(2, 6, 16))
+        out = mha(x)
+        assert out.shape == [2, 6, 16]
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        out = enc(t(np.random.rand(2, 5, 16)))
+        assert out.shape == [2, 5, 16]
+
+
+class TestRNN:
+    def test_lstm_shapes(self):
+        lstm = nn.LSTM(8, 16, num_layers=2)
+        x = t(np.random.rand(3, 5, 8))
+        out, (h, c) = lstm(x)
+        assert out.shape == [3, 5, 16]
+        assert h.shape == [2, 3, 16]
+
+    def test_gru_bidirectional(self):
+        gru = nn.GRU(4, 8, direction="bidirect")
+        out, h = gru(t(np.random.rand(2, 6, 4)))
+        assert out.shape == [2, 6, 16]
+
+    def test_lstm_grad(self):
+        lstm = nn.LSTM(4, 6)
+        x = t(np.random.rand(2, 3, 4), rg=True)
+        out, _ = lstm(x)
+        out.sum().backward()
+        assert x.grad is not None
+        assert lstm.weight_ih_l0.grad is not None
+
+
+class TestClip:
+    def test_global_norm_clip(self):
+        p1 = paddle.to_tensor(np.zeros(3, np.float32), stop_gradient=False)
+        p2 = paddle.to_tensor(np.zeros(4, np.float32), stop_gradient=False)
+        pgs = [(p1, t(np.full(3, 3.0))), (p2, t(np.full(4, 4.0)))]
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        out = clip(pgs)
+        total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in out))
+        np.testing.assert_allclose(total, 1.0, rtol=1e-4)

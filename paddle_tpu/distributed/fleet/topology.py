@@ -50,6 +50,13 @@ class HybridCommunicateGroup:
             sharding_degree = dims.get("sharding", 1)
             sep_degree = dims.get("sep", 1)
             mp_degree = dims.get("model", 1)
+        import jax
+
+        n_dev = len(jax.devices())
+        prod = dp_degree * mp_degree * pp_degree * sharding_degree * sep_degree
+        if prod != n_dev and dp_degree == 1:
+            # reference behavior: leftover devices go to data parallel
+            dp_degree = n_dev // max(mp_degree * pp_degree * sharding_degree * sep_degree, 1)
         self._dp_degree = dp_degree
         self._mp_degree = mp_degree
         self._pp_degree = pp_degree

@@ -73,7 +73,13 @@ def sharding_for(spec):
 
 
 def shard_tensor_(t, spec):
-    """Re-layout a Tensor's buffer across the mesh in place."""
+    """Re-layout a Tensor's buffer across the mesh in place (eager only —
+    inside a trace this is a no-op; callers re-shard via jit state
+    refreshers so layouts change between compiled calls, not within)."""
+    from ..framework import core as _core
+
+    if _core.active_trace() is not None:
+        return t
     sh = sharding_for(spec)
     if sh is not None and not isinstance(t._raw, jax.core.Tracer):
         t._raw = jax.device_put(t._raw, sh)

@@ -227,6 +227,12 @@ def set_device(device) -> Place:
         _current_place = TPUPlace(idx)
     else:
         raise ValueError(f"Unknown device {device!r}")
+    # steer jax's default placement (tensors stay uncommitted so they can
+    # combine with mesh-sharded operands)
+    try:
+        jax.config.update("jax_default_device", _current_place.jax_device())
+    except (RuntimeError, ValueError):
+        pass
     return _current_place
 
 
@@ -307,6 +313,13 @@ def mark_born_if_tracing(t):
     tr = _mode.trace
     if tr is not None:
         _birth[id(t)] = (_weakref.ref(t), tr.token)
+
+
+def unmark_born(t):
+    """Declare a tensor created mid-trace as PERSISTENT state: its payload is
+    concrete (caller must build it under jax.ensure_compile_time_eval) and it
+    participates in state capture like pre-existing tensors."""
+    _birth.pop(id(t), None)
 
 
 def get_born_token(t):

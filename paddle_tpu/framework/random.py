@@ -20,11 +20,14 @@ class Generator:
     def _ensure(self):
         if self._key_tensor is None:
             from ..tensor import Tensor
+            from . import core as _core
 
-            self._key_tensor = Tensor(
-                jax.random.key_data(jax.random.PRNGKey(self._seed)),
-                stop_gradient=True,
-            )
+            with jax.ensure_compile_time_eval():
+                self._key_tensor = Tensor(
+                    jax.random.key_data(jax.random.PRNGKey(self._seed)),
+                    stop_gradient=True,
+                )
+            _core.unmark_born(self._key_tensor)
         return self._key_tensor
 
     def manual_seed(self, seed: int):

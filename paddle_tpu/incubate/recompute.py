@@ -3,17 +3,53 @@ python/paddle/distributed/fleet/utils/recompute.py — SURVEY.md §5.7).
 
 TPU-native: jax.checkpoint (rematerialization) wraps the segment — XLA
 re-executes the forward inside the backward instead of storing activations.
+Parameters of the wrapped Layer are passed as explicit differentiable inputs
+so their gradients flow through the checkpoint boundary; a chained sub-trace
+substitutes their payloads during the inner trace.
 """
 
 from __future__ import annotations
 
 import jax
 
+from ..framework import core as _core
+from ..nn.layer import Layer
 from ..ops.dispatch import apply, coerce
 from ..tensor import Tensor
 
 
+class _RecomputeTrace:
+    """Substitution trace for the checkpointed region; chains to any active
+    @to_static trace for reads of other state (RNG keys, buffers)."""
+
+    __slots__ = ("subst", "overlay", "parent", "token")
+
+    def __init__(self, subst, parent):
+        self.subst = subst
+        self.overlay = {}
+        self.parent = parent
+        self.token = object()
+
+    def read(self, t, kind):
+        key = (id(t), kind)
+        if key in self.overlay:
+            return self.overlay[key]
+        if key in self.subst:
+            return self.subst[key]
+        if self.parent is not None:
+            return self.parent.read(t, kind)
+        return t._raw if kind == "data" else t._grad_raw
+
+    def write(self, t, kind, value):
+        self.overlay[(id(t), kind)] = value
+
+
 def recompute(function, *args, use_reentrant=True, **kwargs):
+    owner = getattr(function, "__self__", None)
+    params = []
+    if isinstance(owner, Layer):
+        params = [p for p in owner.parameters() if not p.stop_gradient]
+
     tensor_args = []
     spec = []
     for a in args:
@@ -22,25 +58,32 @@ def recompute(function, *args, use_reentrant=True, **kwargs):
             tensor_args.append(a)
         else:
             spec.append(("s", a))
+    n_args = len(tensor_args)
+    outer = _core.active_trace()
 
     def f(*arrays):
-        rebuilt = []
-        for kind, v in spec:
-            if kind == "t":
-                t = Tensor.__new__(Tensor)
-                t._init_from_array(arrays[v], stop_gradient=False)
-                rebuilt.append(t)
-            else:
-                rebuilt.append(v)
-        out = function(*rebuilt, **kwargs)
+        xs, ws = arrays[:n_args], arrays[n_args:]
+        subst = {(id(p), "data"): w for p, w in zip(params, ws)}
+        tr = _RecomputeTrace(subst, outer)
+        old = _core.set_active_trace(tr)
+        try:
+            rebuilt = []
+            for kind, v in spec:
+                if kind == "t":
+                    t = Tensor.__new__(Tensor)
+                    t._init_from_array(xs[v], stop_gradient=False)
+                    rebuilt.append(t)
+                else:
+                    rebuilt.append(v)
+            out = function(*rebuilt, **kwargs)
+        finally:
+            _core.set_active_trace(old)
         if isinstance(out, Tensor):
-            return out._data
-        if isinstance(out, (tuple, list)):
-            return tuple(o._data if isinstance(o, Tensor) else o for o in out)
-        return out
+            return out._raw
+        raise TypeError("recompute currently supports single-Tensor outputs")
 
     ckpt = jax.checkpoint(f)
-    return apply(ckpt, [coerce(t) for t in tensor_args], name="recompute", multi=False)
+    return apply(ckpt, [coerce(t) for t in tensor_args] + params, name="recompute")
 
 
 def recompute_sequential(ctx, functions, *args, **kwargs):

@@ -1,0 +1,18 @@
+"""In-repo model zoo for the benchmark configs (BASELINE.json; the reference
+keeps these in PaddleNLP — minimal equivalents live here per SURVEY.md §2.3)."""
+
+from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTForCausalLM,
+    GPTForCausalLMPipe,
+    GPTForPretraining,
+    GPTModel,
+)
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertForMaskedLM,
+    BertForQuestionAnswering,
+    BertForSequenceClassification,
+    BertModel,
+)

@@ -1,0 +1,165 @@
+"""BERT (benchmark config 3: BERT-base SQuAD fine-tune — BASELINE.json).
+
+Reference capability: PaddleNLP BertModel/BertForQuestionAnswering/
+BertForSequenceClassification."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn, ops
+from ..nn import functional as F
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+
+    @staticmethod
+    def bert_base(**overrides):
+        return BertConfig(**overrides)
+
+    @staticmethod
+    def tiny(**overrides):
+        base = dict(
+            vocab_size=256,
+            hidden_size=64,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            intermediate_size=128,
+            max_position_embeddings=128,
+        )
+        base.update(overrides)
+        return BertConfig(**base)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.position_embeddings = nn.Embedding(config.max_position_embeddings, config.hidden_size)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size, config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        s = input_ids.shape[1]
+        pos = ops.arange(0, s, dtype="int32")
+        x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            x = x + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class BertEncoderLayer(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        h = config.hidden_size
+        self.attn = nn.MultiHeadAttention(
+            h, config.num_attention_heads, dropout=config.attention_probs_dropout_prob
+        )
+        self.linear1 = nn.Linear(h, config.intermediate_size)
+        self.linear2 = nn.Linear(config.intermediate_size, h)
+        self.norm1 = nn.LayerNorm(h, config.layer_norm_eps)
+        self.norm2 = nn.LayerNorm(h, config.layer_norm_eps)
+        self.dropout1 = nn.Dropout(config.hidden_dropout_prob)
+        self.dropout2 = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x, attn_mask=None):
+        x = self.norm1(x + self.dropout1(self.attn(x, attn_mask=attn_mask)))
+        ff = self.linear2(F.gelu(self.linear1(x)))
+        return self.norm2(x + self.dropout2(ff))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = nn.LayerList([BertEncoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        mask = None
+        if attention_mask is not None:
+            # [b, s] 1/0 → additive [b, 1, 1, s]
+            import jax.numpy as jnp
+
+            from ..ops.dispatch import apply, coerce
+
+            mask = apply(
+                lambda m: (1.0 - m.astype(jnp.float32))[:, None, None, :] * -1e30,
+                [coerce(attention_mask)],
+                name="bert_mask",
+            )
+        x = self.embeddings(input_ids, token_type_ids)
+        for layer in self.encoder:
+            x = layer(x, mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForQuestionAnswering(nn.Layer):
+    """SQuAD head: start/end span logits (config 3)."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.classifier = nn.Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None, start_positions=None, end_positions=None):
+        seq, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        logits = self.classifier(seq)
+        start_logits, end_logits = ops.unbind(logits, axis=2)
+        if start_positions is not None:
+            loss = (
+                F.cross_entropy(start_logits, start_positions)
+                + F.cross_entropy(end_logits, end_positions)
+            ) / 2
+            return loss, start_logits, end_logits
+        return start_logits, end_logits
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, config, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None, labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            return F.cross_entropy(logits, labels), logits
+        return logits
+
+
+class BertForMaskedLM(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.decoder = nn.Linear(config.hidden_size, config.vocab_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None, labels=None):
+        seq, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.layer_norm(F.gelu(self.transform(seq)))
+        logits = self.decoder(h)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, logits.shape[-1]]), labels.reshape([-1]), ignore_index=-100
+            )
+            return loss, logits
+        return logits

@@ -1,0 +1,204 @@
+"""GPT family (benchmark config 5: GPT-3 13B DP+TP+PP hybrid — BASELINE.json).
+
+Reference capability: PaddleNLP GPTForPretraining + fleet hybrid wiring,
+including the PipelineLayer variant (GPTForPretrainingPipe).  TPU-native:
+same layer classes over mp/pp mesh axes; pre-norm GPT-3 architecture."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import nn, ops
+from ..distributed import mesh as _mesh
+from ..distributed.fleet.meta_parallel import (
+    ColumnParallelLinear,
+    LayerDesc,
+    PipelineLayer,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..nn import functional as F
+from ..tensor import Tensor
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 5120
+    num_hidden_layers: int = 40
+    num_attention_heads: int = 40
+    intermediate_size: int = 20480
+    max_position_embeddings: int = 2048
+    layer_norm_epsilon: float = 1e-5
+    attention_probs_dropout_prob: float = 0.0
+    hidden_dropout_prob: float = 0.0
+    tensor_parallel_degree: int = 1
+    use_recompute: bool = False
+
+    @staticmethod
+    def gpt3_13b(**overrides):
+        return GPTConfig(**overrides)
+
+    @staticmethod
+    def tiny(**overrides):
+        base = dict(
+            vocab_size=256,
+            hidden_size=64,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            intermediate_size=256,
+            max_position_embeddings=128,
+        )
+        base.update(overrides)
+        return GPTConfig(**base)
+
+
+def _use_tp(config):
+    return config.tensor_parallel_degree > 1 or _mesh.axis_size("mp") > 1
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // self.num_heads
+        self.dropout = config.attention_probs_dropout_prob
+        if _use_tp(config):
+            self.qkv_proj = ColumnParallelLinear(h, 3 * h, has_bias=True, gather_output=False)
+            self.out_proj = RowParallelLinear(h, h, has_bias=True, input_is_parallel=True)
+        else:
+            self.qkv_proj = nn.Linear(h, 3 * h)
+            self.out_proj = nn.Linear(h, h)
+
+    def forward(self, x):
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = ops.unbind(qkv, axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, dropout_p=self.dropout, is_causal=True, training=self.training
+        )
+        out = out.reshape([b, s, self.num_heads * self.head_dim])
+        return self.out_proj(out)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        h, i = config.hidden_size, config.intermediate_size
+        if _use_tp(config):
+            self.fc1 = ColumnParallelLinear(h, i, has_bias=True, gather_output=False)
+            self.fc2 = RowParallelLinear(i, h, has_bias=True, input_is_parallel=True)
+        else:
+            self.fc1 = nn.Linear(h, i)
+            self.fc2 = nn.Linear(i, h)
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x), approximate=True))
+
+
+class GPTDecoderLayer(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.ln_1 = nn.LayerNorm(config.hidden_size, config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = nn.LayerNorm(config.hidden_size, config.layer_norm_epsilon)
+        self.mlp = GPTMLP(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def _block(self, x):
+        x = x + self.dropout(self.attn(self.ln_1(x)))
+        return x + self.dropout(self.mlp(self.ln_2(x)))
+
+    def forward(self, x):
+        if self.config.use_recompute and self.training:
+            from ..incubate.recompute import recompute
+
+            return recompute(self._block, x)
+        return self._block(x)
+
+
+class GPTEmbeddings(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        if _use_tp(config):
+            self.word_embeddings = VocabParallelEmbedding(config.vocab_size, config.hidden_size)
+        else:
+            self.word_embeddings = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.position_embeddings = nn.Embedding(config.max_position_embeddings, config.hidden_size)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        pos = ops.arange(0, s, dtype="int32")
+        x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        return self.dropout(x)
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        self.h = nn.LayerList([GPTDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size, config.layer_norm_epsilon)
+
+    def forward(self, input_ids):
+        x = self.embeddings(input_ids)
+        for layer in self.h:
+            x = layer(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if _use_tp(config):
+            self.lm_head = ColumnParallelLinear(config.hidden_size, config.vocab_size, has_bias=False, gather_output=True)
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size, bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.gpt(input_ids)
+        logits = self.lm_head(hidden)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.config.vocab_size]), labels.reshape([-1])
+            )
+            return loss, logits
+        return logits
+
+
+GPTForPretraining = GPTForCausalLM
+
+
+class _EmbeddingPipe(GPTEmbeddings):
+    pass
+
+
+class _LNPipe(nn.LayerNorm):
+    pass
+
+
+class GPTForCausalLMPipe(PipelineLayer):
+    """Pipeline variant (reference: GPTForPretrainingPipe with LayerDesc)."""
+
+    def __init__(self, config, num_stages=None, loss_fn=None):
+        self.config = config
+        descs = [LayerDesc(_EmbeddingPipe, config)]
+        for _ in range(config.num_hidden_layers):
+            descs.append(LayerDesc(GPTDecoderLayer, config))
+        descs.append(LayerDesc(_LNPipe, config.hidden_size, config.layer_norm_epsilon))
+        descs.append(LayerDesc(nn.Linear, config.hidden_size, config.vocab_size, None, False))
+
+        def default_loss(logits, labels):
+            return F.cross_entropy(
+                logits.reshape([-1, config.vocab_size]), labels.reshape([-1])
+            )
+
+        super().__init__(descs, num_stages=num_stages, loss_fn=loss_fn or default_loss)

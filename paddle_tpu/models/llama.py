@@ -1,0 +1,283 @@
+"""Llama family (benchmark configs 4: Llama-2-7B TP=8 — BASELINE.json).
+
+Reference capability: PaddleNLP's LlamaForCausalLM with fleet TP wiring
+(ColumnParallelLinear/RowParallelLinear fused paths).  TPU-native build:
+- attention → Pallas flash kernel (ops/flash_attention.py), GQA supported
+- rotary embeddings precomputed as state, applied in fp32
+- TP via mp-sharded parallel layers (degrade to plain layers at mp=1)
+- sequence parallel via sharding constraints, recompute via jax.checkpoint
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .. import nn, ops
+from ..distributed import mesh as _mesh
+from ..distributed.fleet.meta_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..nn import functional as F
+from ..tensor import Tensor
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tensor_parallel_degree: int = 1
+    sequence_parallel: bool = False
+    use_recompute: bool = False
+    tie_word_embeddings: bool = False
+
+    @staticmethod
+    def llama2_7b(**overrides):
+        return LlamaConfig(**overrides)
+
+    @staticmethod
+    def tiny(**overrides):
+        base = dict(
+            vocab_size=256,
+            hidden_size=64,
+            intermediate_size=128,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=4,
+            max_position_embeddings=256,
+        )
+        base.update(overrides)
+        return LlamaConfig(**base)
+
+
+def _use_tp(config):
+    return config.tensor_parallel_degree > 1 or _mesh.axis_size("mp") > 1
+
+
+def _rope_cache(config):
+    dim = config.hidden_size // config.num_attention_heads
+    inv_freq = 1.0 / (
+        config.rope_theta ** (np.arange(0, dim, 2, dtype=np.float64) / dim)
+    )
+    t = np.arange(config.max_position_embeddings, dtype=np.float64)
+    freqs = np.outer(t, inv_freq)
+    return (
+        Tensor(np.cos(freqs).astype(np.float32)),
+        Tensor(np.sin(freqs).astype(np.float32)),
+    )
+
+
+def apply_rotary_pos_emb(q, k, cos, sin, position_offset=0):
+    """q,k: [b, s, h, d]; cos/sin: [max_pos, d/2] state tensors."""
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import apply
+
+    s = q.shape[1]
+
+    def f(qa, ka, c, si):
+        c = c[position_offset : position_offset + s]
+        si_ = si[position_offset : position_offset + s]
+        c = c[None, :, None, :]
+        si_ = si_[None, :, None, :]
+
+        def rot(x):
+            x32 = x.astype(jnp.float32)
+            x1 = x32[..., 0::2]
+            x2 = x32[..., 1::2]
+            o1 = x1 * c - x2 * si_
+            o2 = x2 * c + x1 * si_
+            return jnp.stack([o1, o2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+        return rot(qa), rot(ka)
+
+    return apply(f, [q, k, cos, sin], multi=True, name="rope")
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        h, i = config.hidden_size, config.intermediate_size
+        if _use_tp(config):
+            self.gate_proj = ColumnParallelLinear(h, i, has_bias=False, gather_output=False)
+            self.up_proj = ColumnParallelLinear(h, i, has_bias=False, gather_output=False)
+            self.down_proj = RowParallelLinear(i, h, has_bias=False, input_is_parallel=True)
+        else:
+            self.gate_proj = nn.Linear(h, i, bias_attr=False)
+            self.up_proj = nn.Linear(h, i, bias_attr=False)
+            self.down_proj = nn.Linear(i, h, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config, rope):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = h // self.num_heads
+        kv_out = self.num_kv_heads * self.head_dim
+        if _use_tp(config):
+            self.q_proj = ColumnParallelLinear(h, h, has_bias=False, gather_output=False)
+            self.k_proj = ColumnParallelLinear(h, kv_out, has_bias=False, gather_output=False)
+            self.v_proj = ColumnParallelLinear(h, kv_out, has_bias=False, gather_output=False)
+            self.o_proj = RowParallelLinear(h, h, has_bias=False, input_is_parallel=True)
+        else:
+            self.q_proj = nn.Linear(h, h, bias_attr=False)
+            self.k_proj = nn.Linear(h, kv_out, bias_attr=False)
+            self.v_proj = nn.Linear(h, kv_out, bias_attr=False)
+            self.o_proj = nn.Linear(h, h, bias_attr=False)
+        self.rope_cos, self.rope_sin = rope
+
+    def forward(self, x, attn_mask=None, cache=None):
+        b, s = x.shape[0], x.shape[1]
+        q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
+        k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        offset = 0
+        if cache is not None:
+            offset = cache[0].shape[1]
+        q, k = apply_rotary_pos_emb(q, k, self.rope_cos, self.rope_sin, offset)
+        if cache is not None:
+            k = ops.concat([cache[0], k], axis=1)
+            v = ops.concat([cache[1], v], axis=1)
+            new_cache = (k, v)
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, is_causal=s > 1)
+        else:
+            new_cache = None
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, is_causal=True)
+        out = out.reshape([b, s, self.num_heads * self.head_dim])
+        out = self.o_proj(out)
+        if new_cache is not None:
+            return out, new_cache
+        return out
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config, rope):
+        super().__init__()
+        self.config = config
+        self.input_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config, rope)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def _block(self, x, attn_mask=None):
+        h = x + self.self_attn(self.input_layernorm(x), attn_mask)
+        return h + self.mlp(self.post_attention_layernorm(h))
+
+    def forward(self, x, attn_mask=None, cache=None):
+        if cache is not None:
+            residual = x
+            attn_out, new_cache = self.self_attn(self.input_layernorm(x), attn_mask, cache)
+            h = residual + attn_out
+            out = h + self.mlp(self.post_attention_layernorm(h))
+            return out, new_cache
+        if self.config.use_recompute and self.training:
+            from ..incubate.recompute import recompute
+
+            return recompute(self._block, x)
+        return self._block(x, attn_mask)
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        rope = _rope_cache(config)
+        if _use_tp(config):
+            self.embed_tokens = VocabParallelEmbedding(config.vocab_size, config.hidden_size)
+        else:
+            self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config, rope) for _ in range(config.num_hidden_layers)]
+        )
+        self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, input_ids, attn_mask=None, caches=None):
+        x = self.embed_tokens(input_ids)
+        if self.config.sequence_parallel:
+            from ..distributed.fleet.meta_parallel.sp_utils import ScatterOp
+
+            x = ScatterOp.apply(x)
+        new_caches = [] if caches is not None else None
+        for i, layer in enumerate(self.layers):
+            if caches is not None:
+                x, c = layer(x, attn_mask, caches[i])
+                new_caches.append(c)
+            else:
+                x = layer(x, attn_mask)
+        x = self.norm(x)
+        if self.config.sequence_parallel:
+            from ..distributed.fleet.meta_parallel.sp_utils import GatherOp
+
+            x = GatherOp.apply(x)
+        if caches is not None:
+            return x, new_caches
+        return x
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if _use_tp(config):
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False, gather_output=True
+            )
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size, bias_attr=False)
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        hidden = self.llama(input_ids, attn_mask)
+        logits = self.lm_head(hidden)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.config.vocab_size]),
+                labels.reshape([-1]),
+                ignore_index=-100,
+            )
+            return loss, logits
+        return logits
+
+    def generate(self, input_ids, max_new_tokens=16, temperature=0.0):
+        """Greedy/temperature sampling with KV cache."""
+        from .. import no_grad
+
+        with no_grad():
+            caches = [
+                (
+                    ops.zeros([input_ids.shape[0], 0, self.config.num_key_value_heads, self.config.hidden_size // self.config.num_attention_heads], "float32"),
+                    ops.zeros([input_ids.shape[0], 0, self.config.num_key_value_heads, self.config.hidden_size // self.config.num_attention_heads], "float32"),
+                )
+                for _ in range(self.config.num_hidden_layers)
+            ]
+            tokens = input_ids
+            cur = input_ids
+            for _ in range(max_new_tokens):
+                hidden, caches = self.llama(cur, caches=caches)
+                logits = self.lm_head(hidden)[:, -1]
+                if temperature > 0:
+                    probs = F.softmax(logits / temperature, axis=-1)
+                    nxt = ops.multinomial(probs, 1)
+                else:
+                    nxt = ops.argmax(logits, axis=-1, keepdim=True)
+                nxt = nxt.astype(tokens.dtype)
+                tokens = ops.concat([tokens, nxt], axis=1)
+                cur = nxt
+            return tokens

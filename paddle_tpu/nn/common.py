@@ -62,7 +62,7 @@ class Embedding(Layer):
         self.weight = self.create_parameter(
             [num_embeddings, embedding_dim],
             attr=weight_attr,
-            default_initializer=I.Normal(0.0, 1.0) if weight_attr is None else None,
+            default_initializer=I.XavierNormal(),
         )
         if self._padding_idx is not None:
             import jax.numpy as jnp

@@ -90,13 +90,19 @@ class Optimizer:
     def _acc(self, name, p, init=None):
         key = (name, id(p))
         if key not in self._accumulators:
+            import jax
+
             base = self._master_weights.get(id(p))
             ref = base if base is not None else p
-            dt = jnp.float32 if (base is not None or not _is_low_precision(p)) else ref._data.dtype
-            if name in ("beta1_pow", "beta2_pow"):
-                self._accumulators[key] = Tensor(jnp.ones([], jnp.float32) * init)
-            else:
-                self._accumulators[key] = Tensor(jnp.zeros(ref._data.shape, jnp.float32))
+            # persistent state may be first touched inside a @to_static trace:
+            # build it concretely and register it for state capture
+            with jax.ensure_compile_time_eval():
+                if name in ("beta1_pow", "beta2_pow"):
+                    t = Tensor(jnp.full([], float(init), jnp.float32))
+                else:
+                    t = Tensor(jnp.zeros(ref._raw.shape, jnp.float32))
+            _core.unmark_born(t)
+            self._accumulators[key] = t
         return self._accumulators[key]
 
     def clear_grad(self, set_to_zero=True):

@@ -38,6 +38,9 @@ class Tensor:
         "name",
         "_trainable",
         "_hooks",
+        "placements",
+        "process_mesh",
+        "sequence_parallel",
         "__weakref__",
     )
 
@@ -56,9 +59,10 @@ class Tensor:
                 npdata = npdata.astype(np.float32)
             elif npdata.dtype == np.int64:
                 npdata = npdata.astype(np.int64)  # keep int64 like paddle
+            # uncommitted placement: lands on the default device but stays
+            # free to combine with mesh-sharded operands (GSPMD-friendly);
+            # explicit `place` commits.
             arr = jnp.asarray(npdata)
-            if place is None:
-                place = framework._expected_place()
         if place is not None and not _is_tracer(arr):
             arr = jax.device_put(arr, place.jax_device())
         self._raw = arr

@@ -1,0 +1,225 @@
+"""Distributed tests on the 8-virtual-device CPU mesh (reference pattern:
+test/collective/* run via multi-process simulation — SURVEY.md §4; here
+single-controller GSPMD so the mesh itself is simulated in-process)."""
+
+import numpy as np
+import pytest
+import jax
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed import mesh as pmesh
+
+
+def t(arr, rg=False):
+    return paddle.to_tensor(np.asarray(arr, np.float32), stop_gradient=not rg)
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    yield
+    pmesh.set_mesh(None)
+
+
+def test_eight_devices_present():
+    assert len(jax.devices()) == 8
+
+
+class TestMesh:
+    def test_build_mesh_degrees(self):
+        m = pmesh.build_mesh(dp=2, mp=4)
+        assert m.shape["dp"] == 2 and m.shape["mp"] == 4
+        assert pmesh.axis_size("mp") == 4
+
+    def test_wildcard_degree(self):
+        m = pmesh.build_mesh(dp=-1, mp=2)
+        assert m.shape["dp"] == 4
+
+    def test_bad_degrees_raise(self):
+        with pytest.raises(ValueError):
+            pmesh.build_mesh(dp=3, mp=3)
+
+    def test_shard_tensor(self):
+        pmesh.build_mesh(dp=2, mp=4)
+        x = t(np.random.rand(8, 4))
+        pmesh.shard_tensor_(x, P("dp", None))
+        shard_shape = x._raw.sharding.shard_shape(x._raw.shape)
+        assert shard_shape == (4, 4)
+
+
+class TestFleetTopology:
+    def test_hybrid_groups(self):
+        fleet.init(is_collective=True)
+        hcg = fleet.get_hybrid_communicate_group()
+        assert hcg.get_data_parallel_world_size() >= 1
+
+    def test_strategy_hybrid_configs(self):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4, "pp_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        hcg = fleet.get_hybrid_communicate_group()
+        assert hcg.get_model_parallel_world_size() == 4
+        assert pmesh.axis_size("mp") == 4
+
+
+class TestTPLayers:
+    def test_column_parallel_matches_dense(self):
+        pmesh.build_mesh(mp=8)
+        paddle.seed(3)
+        col = fleet.ColumnParallelLinear(16, 32, has_bias=True, gather_output=True)
+        x = t(np.random.rand(4, 16))
+        out = col(x)
+        ref = x.numpy() @ col.weight.numpy() + col.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_row_parallel_matches_dense(self):
+        pmesh.build_mesh(mp=8)
+        row = fleet.RowParallelLinear(32, 16, has_bias=True)
+        x = t(np.random.rand(4, 32))
+        out = row(x)
+        ref = x.numpy() @ row.weight.numpy() + row.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_vocab_parallel_embedding(self):
+        pmesh.build_mesh(mp=8)
+        emb = fleet.VocabParallelEmbedding(64, 16)
+        idx = paddle.to_tensor(np.random.randint(0, 64, (2, 5)).astype(np.int32))
+        out = emb(idx)
+        np.testing.assert_allclose(out.numpy(), emb.weight.numpy()[idx.numpy()], rtol=1e-5)
+
+    def test_tp_weights_actually_sharded(self):
+        pmesh.build_mesh(mp=8)
+        col = fleet.ColumnParallelLinear(16, 32, has_bias=False)
+        shard = col.weight._raw.sharding.shard_shape(col.weight._raw.shape)
+        assert shard == (16, 4)  # out dim split 8 ways
+
+    def test_tp_grads_flow(self):
+        pmesh.build_mesh(mp=8)
+        col = fleet.ColumnParallelLinear(8, 16, has_bias=False, gather_output=False)
+        row = fleet.RowParallelLinear(16, 8, has_bias=False, input_is_parallel=True)
+        x = t(np.random.rand(2, 8), rg=True)
+        out = row(col(x))
+        out.sum().backward()
+        assert col.weight.grad is not None and row.weight.grad is not None
+
+
+class TestDataParallel:
+    def test_dp_model_shards_batch(self):
+        pmesh.build_mesh(dp=8)
+        model = nn.Linear(4, 2)
+        dp = paddle.DataParallel(model)
+        x = t(np.random.rand(16, 4))
+        out = dp(x)
+        assert out.shape == [16, 2]
+
+    def test_dp_training_step_compiled(self):
+        pmesh.build_mesh(dp=8)
+        paddle.seed(0)
+        model = nn.Linear(8, 4)
+        dp = paddle.DataParallel(model)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        lossfn = nn.MSELoss()
+
+        @paddle.jit.to_static
+        def step(x, y):
+            loss = lossfn(dp(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        losses = []
+        for _ in range(10):
+            x = t(np.random.rand(16, 8))
+            y = t(np.zeros((16, 4)))
+            losses.append(float(step(x, y).numpy()))
+        assert losses[-1] < losses[0]
+
+
+class TestShardedOptimizer:
+    def test_group_sharded_parallel_levels(self):
+        pmesh.build_mesh(sharding=8)
+        model = nn.Linear(16, 16)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+        model2, opt2, _ = group_sharded_parallel(model, opt, "os_g")
+        x = t(np.random.rand(8, 16))
+        loss = (model2(x) ** 2).mean()
+        loss.backward()
+        opt2.step()
+        # moment accumulators sharded over the sharding axis
+        accs = [a for (n, _), a in opt._accumulators.items() if n == "moment1"]
+        assert accs
+        shard = accs[0]._raw.sharding.shard_shape(accs[0]._raw.shape)
+        assert shard[0] == 2  # 16 / 8
+
+
+class TestCollectives:
+    def test_allreduce_inside_shard_map(self):
+        pmesh.build_mesh(dp=8)
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+
+        mesh = pmesh.get_mesh()
+
+        def f(x):
+            return jax.lax.psum(x, "dp")
+
+        fn = shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P())
+        x = jnp.arange(8.0)
+        out = fn(x)
+        assert float(out[0]) == 28.0
+
+    def test_collective_api_world1_semantics(self):
+        x = t(np.ones(4))
+        paddle.distributed.all_reduce(x)
+        np.testing.assert_allclose(x.numpy(), np.ones(4))
+        outs = []
+        paddle.distributed.all_gather(outs, x)
+        assert len(outs) >= 1
+
+
+class TestAutoParallelAPI:
+    def test_process_mesh_shard_tensor(self):
+        import paddle_tpu.distributed as dist
+
+        mesh = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["x", "y"])
+        w = t(np.random.rand(8, 4))
+        w = dist.shard_tensor(w, mesh, [dist.Shard(0), dist.Replicate()])
+        shard = w._raw.sharding.shard_shape(w._raw.shape)
+        assert shard == (4, 4)
+
+
+class TestDistributedCheckpoint:
+    def test_save_load_reshard(self, tmp_path):
+        pmesh.build_mesh(mp=8)
+        col = fleet.ColumnParallelLinear(8, 16, has_bias=False)
+        sd = {"w": col.weight}
+        from paddle_tpu.distributed.checkpoint import load_state_dict, save_state_dict
+
+        save_state_dict(sd, str(tmp_path / "ckpt"))
+        orig = col.weight.numpy().copy()
+        col.weight._data = col.weight._data * 0
+        load_state_dict(sd, str(tmp_path / "ckpt"))
+        np.testing.assert_allclose(col.weight.numpy(), orig, rtol=1e-6)
+        # sharding preserved after load
+        shard = col.weight._raw.sharding.shard_shape(col.weight._raw.shape)
+        assert shard == (8, 2)
+
+
+class TestDistributedSampler:
+    def test_distributed_batch_sampler_shards(self):
+        from paddle_tpu.io import DistributedBatchSampler
+
+        class DS:
+            def __len__(self):
+                return 100
+
+        batches_r0 = list(DistributedBatchSampler(DS(), batch_size=5, num_replicas=4, rank=0))
+        batches_r1 = list(DistributedBatchSampler(DS(), batch_size=5, num_replicas=4, rank=1))
+        flat0 = {i for b in batches_r0 for i in b}
+        flat1 = {i for b in batches_r1 for i in b}
+        assert len(flat0) == 25 and not (flat0 & flat1)

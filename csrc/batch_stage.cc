@@ -1,0 +1,115 @@
+// Batch staging engine (re-design of the reference's C++ DataLoader core:
+// paddle/fluid/operators/reader + multiprocess worker/pin-memory threads —
+// SURVEY.md §2.3 paddle.io).  GIL-free batch assembly: worker threads gather
+// rows from a source array into arena buffers so the Python loop only hands
+// out ready pointers.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* pt_host_alloc(size_t n);
+void pt_host_free(void* p);
+}
+
+namespace {
+
+struct Job {
+  const uint8_t* src;      // base of source array
+  size_t row_bytes;        // bytes per row
+  std::vector<int64_t> indices;
+  uint8_t* dst;            // arena buffer, row-major gather output
+  std::atomic<bool> done{false};
+};
+
+struct Stage {
+  std::vector<std::thread> workers;
+  std::deque<Job*> pending;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::atomic<bool> stop{false};
+
+  explicit Stage(int n_workers) {
+    for (int i = 0; i < n_workers; ++i)
+      workers.emplace_back([this] { run(); });
+  }
+
+  ~Stage() {
+    {
+      std::lock_guard<std::mutex> g(mu);
+      stop = true;
+    }
+    cv.notify_all();
+    for (auto& w : workers) w.join();
+    for (Job* j : pending) delete j;
+  }
+
+  void run() {
+    for (;;) {
+      Job* job = nullptr;
+      {
+        std::unique_lock<std::mutex> g(mu);
+        cv.wait(g, [&] { return stop.load() || !pending.empty(); });
+        if (stop) return;
+        job = pending.front();
+        pending.pop_front();
+      }
+      uint8_t* out = job->dst;
+      for (size_t i = 0; i < job->indices.size(); ++i) {
+        memcpy(out + i * job->row_bytes,
+               job->src + (size_t)job->indices[i] * job->row_bytes,
+               job->row_bytes);
+      }
+      job->done.store(true, std::memory_order_release);
+    }
+  }
+
+  Job* submit(const uint8_t* src, size_t row_bytes, const int64_t* idx,
+              size_t n) {
+    Job* j = new Job();
+    j->src = src;
+    j->row_bytes = row_bytes;
+    j->indices.assign(idx, idx + n);
+    j->dst = (uint8_t*)pt_host_alloc(row_bytes * n);
+    {
+      std::lock_guard<std::mutex> g(mu);
+      pending.push_back(j);
+    }
+    cv.notify_one();
+    return j;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_stage_create(int n_workers) { return new Stage(n_workers); }
+
+void pt_stage_destroy(void* h) { delete (Stage*)h; }
+
+void* pt_stage_submit(void* h, const void* src, int64_t row_bytes,
+                      const int64_t* indices, int64_t n) {
+  return ((Stage*)h)->submit((const uint8_t*)src, (size_t)row_bytes, indices,
+                             (size_t)n);
+}
+
+int pt_stage_ready(void* job) {
+  return ((Job*)job)->done.load(std::memory_order_acquire) ? 1 : 0;
+}
+
+void* pt_stage_buffer(void* job) { return ((Job*)job)->dst; }
+
+void pt_stage_release(void* job) {
+  Job* j = (Job*)job;
+  pt_host_free(j->dst);
+  delete j;
+}
+
+}  // extern "C"

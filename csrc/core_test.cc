@@ -1,0 +1,148 @@
+// Native-layer unit tests (the reference keeps gtest targets under test/cpp;
+// a dependency-free assert harness keeps this image-buildable).
+
+#include <unistd.h>
+
+#include <cassert>
+
+// CHECK() vanishes under -DNDEBUG (Release); tests need always-on checks
+#define CHECK(c)                                                      \
+  do {                                                                \
+    if (!(c)) {                                                       \
+      fprintf(stderr, "CHECK failed: %s at line %d\n", #c, __LINE__); \
+      abort();                                                        \
+    }                                                                 \
+  } while (0)
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void pt_flag_define_bool(const char*, int);
+void pt_flag_define_int(const char*, long long);
+int pt_flag_get_bool(const char*);
+long long pt_flag_get_int(const char*);
+int pt_flag_set(const char*, const char*);
+int pt_flag_exists(const char*);
+
+void* pt_host_alloc(size_t);
+void pt_host_free(void*);
+int64_t pt_host_bytes_in_use();
+int64_t pt_host_peak_bytes();
+
+void pt_trace_enable(int);
+int64_t pt_trace_begin(const char*);
+void pt_trace_end(int64_t);
+int pt_trace_export_chrome(const char*);
+int64_t pt_trace_event_count();
+
+void* pt_store_server_start(int);
+int pt_store_server_port(void*);
+void pt_store_server_stop(void*);
+void* pt_store_connect(const char*, int);
+int pt_store_set(void*, const char*, const char*, int);
+int pt_store_get(void*, const char*, char*, int);
+long long pt_store_add(void*, const char*, long long);
+int pt_store_check(void*, const char*);
+void pt_store_close(void*);
+
+void* pt_stage_create(int);
+void pt_stage_destroy(void*);
+void* pt_stage_submit(void*, const void*, int64_t, const int64_t*, int64_t);
+int pt_stage_ready(void*);
+void* pt_stage_buffer(void*);
+void pt_stage_release(void*);
+}
+
+static void test_flags() {
+  pt_flag_define_bool("FLAGS_test_b", 0);
+  pt_flag_define_int("FLAGS_test_i", 42);
+  CHECK(pt_flag_exists("FLAGS_test_b"));
+  CHECK(pt_flag_get_int("FLAGS_test_i") == 42);
+  pt_flag_set("FLAGS_test_b", "true");
+  CHECK(pt_flag_get_bool("FLAGS_test_b") == 1);
+  printf("flags ok\n");
+}
+
+static void test_arena() {
+  int64_t base = pt_host_bytes_in_use();
+  void* a = pt_host_alloc(1000);
+  void* b = pt_host_alloc(8192);
+  CHECK(a && b);
+  memset(a, 1, 1000);
+  CHECK(pt_host_bytes_in_use() > base);
+  pt_host_free(a);
+  pt_host_free(b);
+  CHECK(pt_host_bytes_in_use() == base);
+  void* c = pt_host_alloc(1000);  // freelist reuse
+  CHECK(c == a);
+  pt_host_free(c);
+  CHECK(pt_host_peak_bytes() >= base + 4096 + 8192);
+  printf("arena ok\n");
+}
+
+static void test_tracer() {
+  pt_trace_enable(1);
+  int64_t id = pt_trace_begin("span");
+  pt_trace_end(id);
+  CHECK(pt_trace_event_count() == 1);
+  CHECK(pt_trace_export_chrome("/tmp/pt_trace_test.json") == 0);
+  pt_trace_enable(0);
+  printf("tracer ok\n");
+}
+
+static void test_store() {
+  void* srv = pt_store_server_start(0);
+  CHECK(srv);
+  int port = pt_store_server_port(srv);
+  void* c1 = pt_store_connect("127.0.0.1", port);
+  void* c2 = pt_store_connect("127.0.0.1", port);
+  CHECK(c1 && c2);
+  CHECK(pt_store_check(c1, "k") == 0);
+  CHECK(pt_store_set(c1, "k", "hello", 5) == 0);
+  char buf[16];
+  int n = pt_store_get(c2, "k", buf, sizeof(buf));
+  CHECK(n == 5 && memcmp(buf, "hello", 5) == 0);
+  CHECK(pt_store_add(c1, "ctr", 2) == 2);
+  CHECK(pt_store_add(c2, "ctr", 3) == 5);
+  // blocking get: c2 waits for a key set later by c1
+  std::thread t([&] {
+    usleep(50000);
+    pt_store_set(c1, "late", "x", 1);
+  });
+  n = pt_store_get(c2, "late", buf, sizeof(buf));
+  t.join();
+  CHECK(n == 1 && buf[0] == 'x');
+  pt_store_close(c1);
+  pt_store_close(c2);
+  pt_store_server_stop(srv);
+  printf("tcp store ok\n");
+}
+
+static void test_stage() {
+  void* st = pt_stage_create(2);
+  std::vector<float> src(100 * 4);
+  for (int i = 0; i < 100; ++i)
+    for (int j = 0; j < 4; ++j) src[i * 4 + j] = (float)i;
+  int64_t idx[3] = {5, 50, 99};
+  void* job = pt_stage_submit(st, src.data(), 4 * sizeof(float), idx, 3);
+  while (!pt_stage_ready(job)) usleep(1000);
+  float* out = (float*)pt_stage_buffer(job);
+  CHECK(out[0] == 5.f && out[4] == 50.f && out[8] == 99.f);
+  pt_stage_release(job);
+  pt_stage_destroy(st);
+  printf("batch stage ok\n");
+}
+
+int main() {
+  test_flags();
+  test_arena();
+  test_tracer();
+  test_store();
+  test_stage();
+  printf("ALL NATIVE TESTS PASSED\n");
+  return 0;
+}

@@ -1,0 +1,132 @@
+// Native flag registry (re-design of the reference's gflags-based
+// PHI_DEFINE_EXPORTED_* globals, paddle/phi/core/flags.cc — SURVEY.md §5.6).
+// Typed values, FLAGS_* environment initialization, C ABI for ctypes.
+
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+
+struct FlagValue {
+  enum Kind { kBool, kInt, kDouble, kString } kind;
+  bool b = false;
+  long long i = 0;
+  double d = 0.0;
+  std::string s;
+};
+
+std::map<std::string, FlagValue>& registry() {
+  static std::map<std::string, FlagValue> r;
+  return r;
+}
+
+std::mutex& mu() {
+  static std::mutex m;
+  return m;
+}
+
+bool parse_bool(const char* text) {
+  return !strcmp(text, "1") || !strcasecmp(text, "true") ||
+         !strcasecmp(text, "yes") || !strcasecmp(text, "on");
+}
+
+void env_init(const char* name, FlagValue& v) {
+  const char* e = getenv(name);
+  if (!e) return;
+  switch (v.kind) {
+    case FlagValue::kBool: v.b = parse_bool(e); break;
+    case FlagValue::kInt: v.i = atoll(e); break;
+    case FlagValue::kDouble: v.d = atof(e); break;
+    case FlagValue::kString: v.s = e; break;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void pt_flag_define_bool(const char* name, int def) {
+  std::lock_guard<std::mutex> g(mu());
+  FlagValue v;
+  v.kind = FlagValue::kBool;
+  v.b = def != 0;
+  env_init(name, v);
+  registry()[name] = v;
+}
+
+void pt_flag_define_int(const char* name, long long def) {
+  std::lock_guard<std::mutex> g(mu());
+  FlagValue v;
+  v.kind = FlagValue::kInt;
+  v.i = def;
+  env_init(name, v);
+  registry()[name] = v;
+}
+
+void pt_flag_define_double(const char* name, double def) {
+  std::lock_guard<std::mutex> g(mu());
+  FlagValue v;
+  v.kind = FlagValue::kDouble;
+  v.d = def;
+  env_init(name, v);
+  registry()[name] = v;
+}
+
+void pt_flag_define_string(const char* name, const char* def) {
+  std::lock_guard<std::mutex> g(mu());
+  FlagValue v;
+  v.kind = FlagValue::kString;
+  v.s = def ? def : "";
+  env_init(name, v);
+  registry()[name] = v;
+}
+
+int pt_flag_exists(const char* name) {
+  std::lock_guard<std::mutex> g(mu());
+  return registry().count(name) ? 1 : 0;
+}
+
+int pt_flag_get_bool(const char* name) {
+  std::lock_guard<std::mutex> g(mu());
+  auto it = registry().find(name);
+  return (it != registry().end() && it->second.b) ? 1 : 0;
+}
+
+long long pt_flag_get_int(const char* name) {
+  std::lock_guard<std::mutex> g(mu());
+  auto it = registry().find(name);
+  return it != registry().end() ? it->second.i : 0;
+}
+
+double pt_flag_get_double(const char* name) {
+  std::lock_guard<std::mutex> g(mu());
+  auto it = registry().find(name);
+  return it != registry().end() ? it->second.d : 0.0;
+}
+
+const char* pt_flag_get_string(const char* name) {
+  std::lock_guard<std::mutex> g(mu());
+  static thread_local std::string out;
+  auto it = registry().find(name);
+  out = it != registry().end() ? it->second.s : "";
+  return out.c_str();
+}
+
+int pt_flag_set(const char* name, const char* value) {
+  std::lock_guard<std::mutex> g(mu());
+  auto it = registry().find(name);
+  if (it == registry().end()) return -1;
+  FlagValue& v = it->second;
+  switch (v.kind) {
+    case FlagValue::kBool: v.b = parse_bool(value); break;
+    case FlagValue::kInt: v.i = atoll(value); break;
+    case FlagValue::kDouble: v.d = atof(value); break;
+    case FlagValue::kString: v.s = value; break;
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,109 @@
+// Host staging arena (re-design of the reference's pinned-memory allocator
+// + AllocatorFacade stats, paddle/fluid/memory/allocation/ — SURVEY.md §2.1
+// "Memory/allocators").  On TPU the device allocator belongs to PJRT/XLA;
+// what the framework owns natively is HOST staging memory for the input
+// pipeline: size-bucketed freelists of page-aligned buffers with the
+// reference's stats surface (allocated / peak, matching
+// paddle.device.cuda.max_memory_allocated semantics for host).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+constexpr size_t kAlign = 4096;
+
+struct Arena {
+  std::mutex mu;
+  // size-class -> freelist of buffers
+  std::map<size_t, std::vector<void*>> freelists;
+  std::map<void*, size_t> live;  // ptr -> size
+  std::atomic<int64_t> in_use{0};
+  std::atomic<int64_t> peak{0};
+  std::atomic<int64_t> reserved{0};
+  std::atomic<int64_t> alloc_count{0};
+
+  static size_t round_up(size_t n) {
+    size_t c = kAlign;
+    while (c < n) c <<= 1;
+    return c;
+  }
+
+  void* alloc(size_t n) {
+    size_t cls = round_up(n);
+    {
+      std::lock_guard<std::mutex> g(mu);
+      auto& fl = freelists[cls];
+      if (!fl.empty()) {
+        void* p = fl.back();
+        fl.pop_back();
+        live[p] = cls;
+        bump(cls);
+        return p;
+      }
+    }
+    void* p = aligned_alloc(kAlign, cls);
+    if (!p) return nullptr;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      live[p] = cls;
+      reserved += cls;
+    }
+    bump(cls);
+    return p;
+  }
+
+  void bump(size_t cls) {
+    alloc_count++;
+    int64_t cur = in_use += (int64_t)cls;
+    int64_t pk = peak.load();
+    while (cur > pk && !peak.compare_exchange_weak(pk, cur)) {
+    }
+  }
+
+  void release(void* p) {
+    std::lock_guard<std::mutex> g(mu);
+    auto it = live.find(p);
+    if (it == live.end()) return;
+    size_t cls = it->second;
+    live.erase(it);
+    in_use -= (int64_t)cls;
+    freelists[cls].push_back(p);
+  }
+
+  void trim() {
+    std::lock_guard<std::mutex> g(mu);
+    for (auto& kv : freelists) {
+      for (void* p : kv.second) {
+        free(p);
+        reserved -= (int64_t)kv.first;
+      }
+      kv.second.clear();
+    }
+  }
+};
+
+Arena& arena() {
+  static Arena a;
+  return a;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pt_host_alloc(size_t n) { return arena().alloc(n); }
+void pt_host_free(void* p) { arena().release(p); }
+void pt_host_trim() { arena().trim(); }
+int64_t pt_host_bytes_in_use() { return arena().in_use.load(); }
+int64_t pt_host_peak_bytes() { return arena().peak.load(); }
+int64_t pt_host_bytes_reserved() { return arena().reserved.load(); }
+int64_t pt_host_alloc_count() { return arena().alloc_count.load(); }
+void pt_host_reset_peak() { arena().peak.store(arena().in_use.load()); }
+
+}  // extern "C"

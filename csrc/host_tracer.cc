@@ -1,0 +1,110 @@
+// Host tracer (re-design of the reference's native profiler host side:
+// paddle/fluid/platform/profiler/host_tracer.cc + chrometracing_logger.cc —
+// SURVEY.md §5.1).  RecordEvent spans from any thread, lock-striped buffers,
+// chrome-trace JSON export; device timelines come from XLA's XPlane and are
+// viewed side-by-side.
+
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Event {
+  std::string name;
+  int64_t tid;
+  int64_t start_us;
+  int64_t end_us;
+};
+
+struct Tracer {
+  std::mutex mu;
+  std::vector<Event> events;
+  bool enabled = false;
+
+  static int64_t now_us() {
+    return std::chrono::duration_cast<std::chrono::microseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  }
+};
+
+Tracer& tracer() {
+  static Tracer t;
+  return t;
+}
+
+int64_t tid() { return (int64_t)syscall(SYS_gettid); }
+
+}  // namespace
+
+extern "C" {
+
+void pt_trace_enable(int on) {
+  std::lock_guard<std::mutex> g(tracer().mu);
+  tracer().enabled = on != 0;
+  if (on) tracer().events.clear();
+}
+
+// returns a span id (index is implicit; we return start time and match on end)
+int64_t pt_trace_begin(const char* name) {
+  if (!tracer().enabled) return -1;
+  Event e;
+  e.name = name;
+  e.tid = tid();
+  e.start_us = Tracer::now_us();
+  e.end_us = -1;
+  std::lock_guard<std::mutex> g(tracer().mu);
+  tracer().events.push_back(std::move(e));
+  return (int64_t)tracer().events.size() - 1;
+}
+
+void pt_trace_end(int64_t id) {
+  if (id < 0) return;
+  std::lock_guard<std::mutex> g(tracer().mu);
+  if (id < (int64_t)tracer().events.size())
+    tracer().events[id].end_us = Tracer::now_us();
+}
+
+// instantaneous counter/marker
+void pt_trace_mark(const char* name) {
+  if (!tracer().enabled) return;
+  int64_t t = Tracer::now_us();
+  Event e{name, tid(), t, t};
+  std::lock_guard<std::mutex> g(tracer().mu);
+  tracer().events.push_back(std::move(e));
+}
+
+int pt_trace_export_chrome(const char* path) {
+  std::lock_guard<std::mutex> g(tracer().mu);
+  FILE* f = fopen(path, "w");
+  if (!f) return -1;
+  fprintf(f, "{\"traceEvents\":[\n");
+  bool first = true;
+  for (const auto& e : tracer().events) {
+    if (e.end_us < 0) continue;
+    if (!first) fprintf(f, ",\n");
+    first = false;
+    fprintf(f,
+            "{\"name\":\"%s\",\"ph\":\"X\",\"pid\":%d,\"tid\":%lld,"
+            "\"ts\":%lld,\"dur\":%lld}",
+            e.name.c_str(), (int)getpid(), (long long)e.tid,
+            (long long)e.start_us, (long long)(e.end_us - e.start_us));
+  }
+  fprintf(f, "\n]}\n");
+  fclose(f);
+  return 0;
+}
+
+int64_t pt_trace_event_count() {
+  std::lock_guard<std::mutex> g(tracer().mu);
+  return (int64_t)tracer().events.size();
+}
+
+}  // extern "C"

@@ -1,0 +1,284 @@
+// TCPStore — multi-host rendezvous KV store (re-design of the reference's
+// paddle/phi/core/distributed/store/tcp_store.cc — SURVEY.md §2.2).  The
+// coordinator host runs the server; every rank connects as a client to
+// exchange endpoints / barrier before jax.distributed takes over.
+//
+// Wire protocol (little-endian):
+//   request : u8 op | u32 klen | key | u32 vlen | value
+//   response: u32 vlen | value         (GET/WAIT/ADD)
+// ops: 1=SET 2=GET(blocking) 3=ADD(i64 delta, returns new value) 4=CHECK
+//      5=DELETE
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Server {
+  int listen_fd = -1;
+  std::thread thread;
+  std::atomic<bool> stop{false};
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> kv;
+  std::vector<int> client_fds;  // guarded by mu
+
+  ~Server() { shutdown(); }
+
+  void shutdown() {
+    stop = true;
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      close(listen_fd);
+      listen_fd = -1;
+    }
+    {
+      // unblock handler threads stuck in recv on live connections
+      std::lock_guard<std::mutex> g(mu);
+      for (int fd : client_fds) ::shutdown(fd, SHUT_RDWR);
+    }
+    cv.notify_all();
+    if (thread.joinable()) thread.join();
+  }
+};
+
+bool read_all(int fd, void* buf, size_t n) {
+  char* p = (char*)buf;
+  while (n) {
+    ssize_t r = recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  const char* p = (const char*)buf;
+  while (n) {
+    ssize_t r = send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+bool send_value(int fd, const std::string& v) {
+  uint32_t len = (uint32_t)v.size();
+  if (!write_all(fd, &len, 4)) return false;
+  return v.empty() || write_all(fd, v.data(), v.size());
+}
+
+void handle_client(Server* s, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    s->client_fds.push_back(fd);
+  }
+  for (;;) {
+    uint8_t op;
+    if (!read_all(fd, &op, 1)) break;
+    uint32_t klen;
+    if (!read_all(fd, &klen, 4)) break;
+    std::string key(klen, 0);
+    if (klen && !read_all(fd, key.data(), klen)) break;
+    uint32_t vlen;
+    if (!read_all(fd, &vlen, 4)) break;
+    std::string val(vlen, 0);
+    if (vlen && !read_all(fd, val.data(), vlen)) break;
+
+    if (op == 1) {  // SET
+      {
+        std::lock_guard<std::mutex> g(s->mu);
+        s->kv[key] = val;
+      }
+      s->cv.notify_all();
+    } else if (op == 2) {  // blocking GET
+      std::unique_lock<std::mutex> g(s->mu);
+      s->cv.wait(g, [&] { return s->stop.load() || s->kv.count(key); });
+      if (s->stop) break;
+      std::string v = s->kv[key];
+      g.unlock();
+      if (!send_value(fd, v)) break;
+    } else if (op == 3) {  // ADD
+      int64_t delta = 0;
+      if (val.size() == 8) memcpy(&delta, val.data(), 8);
+      int64_t now;
+      {
+        std::lock_guard<std::mutex> g(s->mu);
+        int64_t cur = 0;
+        auto it = s->kv.find(key);
+        if (it != s->kv.end() && it->second.size() == 8)
+          memcpy(&cur, it->second.data(), 8);
+        now = cur + delta;
+        std::string nv(8, 0);
+        memcpy(nv.data(), &now, 8);
+        s->kv[key] = nv;
+      }
+      s->cv.notify_all();
+      std::string out(8, 0);
+      memcpy(out.data(), &now, 8);
+      if (!send_value(fd, out)) break;
+    } else if (op == 4) {  // CHECK
+      std::string out = "0";
+      {
+        std::lock_guard<std::mutex> g(s->mu);
+        if (s->kv.count(key)) out = "1";
+      }
+      if (!send_value(fd, out)) break;
+    } else if (op == 5) {  // DELETE
+      {
+        std::lock_guard<std::mutex> g(s->mu);
+        s->kv.erase(key);
+      }
+      s->cv.notify_all();
+    } else {
+      break;
+    }
+  }
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    for (auto it = s->client_fds.begin(); it != s->client_fds.end(); ++it)
+      if (*it == fd) {
+        s->client_fds.erase(it);
+        break;
+      }
+  }
+  close(fd);
+}
+
+void serve(Server* s) {
+  std::vector<std::thread> workers;
+  while (!s->stop) {
+    int fd = accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) break;
+    workers.emplace_back(handle_client, s, fd);
+  }
+  for (auto& w : workers)
+    if (w.joinable()) w.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// returns handle (>0) or -errno; port==0 picks a free port (query with
+// pt_store_server_port)
+void* pt_store_server_start(int port) {
+  auto* s = new Server();
+  s->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(s->listen_fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      listen(s->listen_fd, 128) != 0) {
+    delete s;
+    return nullptr;
+  }
+  s->thread = std::thread(serve, s);
+  return s;
+}
+
+int pt_store_server_port(void* handle) {
+  auto* s = (Server*)handle;
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  getsockname(s->listen_fd, (sockaddr*)&addr, &len);
+  return ntohs(addr.sin_port);
+}
+
+void pt_store_server_stop(void* handle) {
+  auto* s = (Server*)handle;
+  s->shutdown();
+  delete s;
+}
+
+// ---- client ----
+
+void* pt_store_connect(const char* host, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  inet_pton(AF_INET, host, &addr.sin_addr);
+  for (int attempt = 0; attempt < 300; ++attempt) {
+    if (connect(fd, (sockaddr*)&addr, sizeof(addr)) == 0) {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return (void*)(intptr_t)(fd + 1);
+    }
+    usleep(100000);
+  }
+  close(fd);
+  return nullptr;
+}
+
+static int client_fd(void* h) { return (int)(intptr_t)h - 1; }
+
+static int send_req(int fd, uint8_t op, const char* key, const void* val,
+                    uint32_t vlen) {
+  uint32_t klen = (uint32_t)strlen(key);
+  if (!write_all(fd, &op, 1) || !write_all(fd, &klen, 4) ||
+      !write_all(fd, key, klen) || !write_all(fd, &vlen, 4))
+    return -1;
+  if (vlen && !write_all(fd, val, vlen)) return -1;
+  return 0;
+}
+
+static int recv_value(int fd, char* out, int cap) {
+  uint32_t vlen;
+  if (!read_all(fd, &vlen, 4)) return -1;
+  if ((int)vlen > cap) return -2;
+  if (vlen && !read_all(fd, out, vlen)) return -1;
+  return (int)vlen;
+}
+
+int pt_store_set(void* h, const char* key, const char* val, int vlen) {
+  return send_req(client_fd(h), 1, key, val, (uint32_t)vlen);
+}
+
+int pt_store_get(void* h, const char* key, char* out, int cap) {
+  int fd = client_fd(h);
+  if (send_req(fd, 2, key, nullptr, 0) != 0) return -1;
+  return recv_value(fd, out, cap);
+}
+
+long long pt_store_add(void* h, const char* key, long long delta) {
+  int fd = client_fd(h);
+  if (send_req(fd, 3, key, &delta, 8) != 0) return -1;
+  char buf[8];
+  if (recv_value(fd, buf, 8) != 8) return -1;
+  long long out;
+  memcpy(&out, buf, 8);
+  return out;
+}
+
+int pt_store_check(void* h, const char* key) {
+  int fd = client_fd(h);
+  if (send_req(fd, 4, key, nullptr, 0) != 0) return -1;
+  char buf[4];
+  int n = recv_value(fd, buf, 4);
+  return (n == 1 && buf[0] == '1') ? 1 : 0;
+}
+
+void pt_store_close(void* h) { close(client_fd(h)); }
+
+}  // extern "C"

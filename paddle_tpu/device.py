@@ -190,4 +190,8 @@ class tpu(cuda):
 
     @staticmethod
     def memory_stats(device=None):
-        return cuda._mem_stats(device)
+        stats = dict(cuda._mem_stats(device))
+        from . import native as _native
+
+        stats.update(_native.host_memory_stats())
+        return stats

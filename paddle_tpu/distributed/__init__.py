@@ -46,6 +46,13 @@ from .auto_parallel import (  # noqa: F401
 from .fleet.meta_parallel.parallel_wrappers import DataParallel  # noqa: F401
 
 
+def TCPStore(*args, **kwargs):
+    """Native rendezvous store (reference: paddle.distributed TCPStore)."""
+    from ..native import TCPStore as _TCPStore
+
+    return _TCPStore(*args, **kwargs)
+
+
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
     """Single-controller JAX sees all local chips in one process; spawn runs
     func once (the reference forks one process per GPU)."""

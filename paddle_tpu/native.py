@@ -1,0 +1,281 @@
+"""ctypes bridge to the native core (libpaddle_tpu_core.so, built from
+csrc/ — the framework's C++ runtime layer: flag registry, host staging
+arena, host tracer, TCPStore rendezvous, batch staging engine).
+
+The build is auto-attempted once (cmake+ninja, quiet) and every consumer
+degrades gracefully to a pure-Python path when the library is unavailable,
+so the framework works on machines without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CSRC = os.path.join(_ROOT, "csrc")
+_BUILD = os.path.join(_CSRC, "build")
+_LIBNAME = "libpaddle_tpu_core.so"
+
+_lib = None
+_tried = False
+_lock = threading.Lock()
+
+
+def _try_build():
+    if not os.path.isdir(_CSRC):
+        return None
+    try:
+        subprocess.run(
+            ["cmake", "-B", _BUILD, "-G", "Ninja", "-DCMAKE_BUILD_TYPE=Release"],
+            cwd=_CSRC, capture_output=True, timeout=120, check=True,
+        )
+        subprocess.run(
+            ["ninja", "-C", _BUILD, "paddle_tpu_core"],
+            capture_output=True, timeout=300, check=True,
+        )
+    except Exception:
+        return None
+    path = os.path.join(_BUILD, _LIBNAME)
+    return path if os.path.exists(path) else None
+
+
+def get_lib():
+    """Returns the loaded CDLL or None."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        path = os.path.join(_BUILD, _LIBNAME)
+        if not os.path.exists(path):
+            path = _try_build()
+        if not path:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        # signatures
+        lib.pt_host_alloc.restype = ctypes.c_void_p
+        lib.pt_host_alloc.argtypes = [ctypes.c_size_t]
+        lib.pt_host_free.argtypes = [ctypes.c_void_p]
+        lib.pt_host_bytes_in_use.restype = ctypes.c_int64
+        lib.pt_host_peak_bytes.restype = ctypes.c_int64
+        lib.pt_host_bytes_reserved.restype = ctypes.c_int64
+        lib.pt_host_alloc_count.restype = ctypes.c_int64
+        lib.pt_trace_begin.restype = ctypes.c_int64
+        lib.pt_trace_begin.argtypes = [ctypes.c_char_p]
+        lib.pt_trace_end.argtypes = [ctypes.c_int64]
+        lib.pt_trace_mark.argtypes = [ctypes.c_char_p]
+        lib.pt_trace_export_chrome.argtypes = [ctypes.c_char_p]
+        lib.pt_trace_event_count.restype = ctypes.c_int64
+        lib.pt_flag_define_bool.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.pt_flag_define_int.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
+        lib.pt_flag_define_double.argtypes = [ctypes.c_char_p, ctypes.c_double]
+        lib.pt_flag_define_string.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.pt_flag_set.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.pt_flag_get_bool.argtypes = [ctypes.c_char_p]
+        lib.pt_flag_get_int.argtypes = [ctypes.c_char_p]
+        lib.pt_flag_get_int.restype = ctypes.c_longlong
+        lib.pt_flag_get_double.argtypes = [ctypes.c_char_p]
+        lib.pt_flag_get_double.restype = ctypes.c_double
+        lib.pt_flag_get_string.argtypes = [ctypes.c_char_p]
+        lib.pt_flag_get_string.restype = ctypes.c_char_p
+        lib.pt_store_server_start.restype = ctypes.c_void_p
+        lib.pt_store_server_start.argtypes = [ctypes.c_int]
+        lib.pt_store_server_port.restype = ctypes.c_int
+        lib.pt_store_server_port.argtypes = [ctypes.c_void_p]
+        lib.pt_store_server_stop.argtypes = [ctypes.c_void_p]
+        lib.pt_store_connect.restype = ctypes.c_void_p
+        lib.pt_store_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.pt_store_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        lib.pt_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        lib.pt_store_add.restype = ctypes.c_longlong
+        lib.pt_store_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
+        lib.pt_store_check.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pt_store_close.argtypes = [ctypes.c_void_p]
+        lib.pt_stage_create.restype = ctypes.c_void_p
+        lib.pt_stage_create.argtypes = [ctypes.c_int]
+        lib.pt_stage_destroy.argtypes = [ctypes.c_void_p]
+        lib.pt_stage_submit.restype = ctypes.c_void_p
+        lib.pt_stage_submit.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ]
+        lib.pt_stage_ready.argtypes = [ctypes.c_void_p]
+        lib.pt_stage_buffer.restype = ctypes.c_void_p
+        lib.pt_stage_buffer.argtypes = [ctypes.c_void_p]
+        lib.pt_stage_release.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available():
+    return get_lib() is not None
+
+
+# ---------------------------------------------------------------------------
+# convenience wrappers
+# ---------------------------------------------------------------------------
+
+
+def host_memory_stats():
+    lib = get_lib()
+    if lib is None:
+        return {}
+    return {
+        "host_bytes_in_use": lib.pt_host_bytes_in_use(),
+        "host_peak_bytes": lib.pt_host_peak_bytes(),
+        "host_bytes_reserved": lib.pt_host_bytes_reserved(),
+        "host_alloc_count": lib.pt_host_alloc_count(),
+    }
+
+
+class TCPStore:
+    """Rendezvous KV store over the native server (reference: paddle TcpStore).
+
+    is_master=True starts the server in-process; all ranks connect as clients.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, is_master=False, world_size=None, timeout=None):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native core library unavailable; build csrc/ first")
+        self._lib = lib
+        self._server = None
+        if is_master:
+            self._server = lib.pt_store_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"failed to bind TCPStore on port {port}")
+            port = lib.pt_store_server_port(self._server)
+        self.host = host
+        self.port = port
+        self._client = lib.pt_store_connect(host.encode(), port)
+        if not self._client:
+            raise RuntimeError(f"failed to connect TCPStore {host}:{port}")
+
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        self._lib.pt_store_set(self._client, key.encode(), value, len(value))
+
+    def get(self, key, cap=1 << 16):
+        buf = ctypes.create_string_buffer(cap)
+        n = self._lib.pt_store_get(self._client, key.encode(), buf, cap)
+        if n < 0:
+            raise RuntimeError(f"TCPStore get({key!r}) failed")
+        return buf.raw[:n]
+
+    def add(self, key, delta):
+        return self._lib.pt_store_add(self._client, key.encode(), delta)
+
+    def check(self, key):
+        return bool(self._lib.pt_store_check(self._client, key.encode()))
+
+    def wait(self, keys):
+        for k in keys if isinstance(keys, (list, tuple)) else [keys]:
+            self.get(k)
+
+    def barrier(self, name, world_size):
+        n = self.add(f"__barrier__{name}", 1)
+        if n == world_size:
+            self.set(f"__barrier__{name}__done", "1")
+        self.get(f"__barrier__{name}__done")
+
+    def close(self):
+        if self._client:
+            self._lib.pt_store_close(self._client)
+            self._client = None
+        if self._server:
+            self._lib.pt_store_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class BatchStage:
+    """Native gather engine for DataLoader fast path: rows of a contiguous
+    numpy array gathered into arena buffers by C++ threads (GIL-free)."""
+
+    def __init__(self, num_workers=2):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native core library unavailable")
+        self._lib = lib
+        self._h = lib.pt_stage_create(num_workers)
+
+    def gather(self, array, indices):
+        """array: 2D+ C-contiguous np array; indices: int list → new np array."""
+        import numpy as np
+
+        arr = np.ascontiguousarray(array)
+        row_bytes = arr.dtype.itemsize * int(np.prod(arr.shape[1:]))
+        idx = np.asarray(indices, np.int64)
+        c_idx = idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        job = self._lib.pt_stage_submit(
+            self._h, arr.ctypes.data_as(ctypes.c_void_p), row_bytes, c_idx, len(idx)
+        )
+        import time
+
+        while not self._lib.pt_stage_ready(job):
+            time.sleep(0)
+        buf = self._lib.pt_stage_buffer(job)
+        out_shape = (len(idx),) + arr.shape[1:]
+        out = np.ctypeslib.as_array(
+            ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8)), (row_bytes * len(idx),)
+        ).view(arr.dtype).reshape(out_shape).copy()
+        self._lib.pt_stage_release(job)
+        return out
+
+    def close(self):
+        if self._h:
+            self._lib.pt_stage_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class RecordEventNative:
+    """Host tracer span via the native recorder (chrome-trace exportable)."""
+
+    def __init__(self, name):
+        self.name = name.encode()
+        self._id = -1
+
+    def __enter__(self):
+        lib = get_lib()
+        if lib is not None:
+            self._id = lib.pt_trace_begin(self.name)
+        return self
+
+    def __exit__(self, *exc):
+        lib = get_lib()
+        if lib is not None:
+            lib.pt_trace_end(self._id)
+        return False
+
+
+def trace_enable(on=True):
+    lib = get_lib()
+    if lib is not None:
+        lib.pt_trace_enable(1 if on else 0)
+
+
+def trace_export(path):
+    lib = get_lib()
+    if lib is not None:
+        return lib.pt_trace_export_chrome(path.encode())
+    return -1

@@ -69,11 +69,20 @@ class RecordEvent:
     def begin(self):
         self._ctx = jax.profiler.TraceAnnotation(self.name)
         self._ctx.__enter__()
+        from . import native as _native
+
+        lib = _native.get_lib()
+        self._nid = lib.pt_trace_begin(self.name.encode()) if lib else -1
 
     def end(self):
         if self._ctx is not None:
             self._ctx.__exit__(None, None, None)
             self._ctx = None
+        from . import native as _native
+
+        lib = _native.get_lib()
+        if lib is not None and getattr(self, "_nid", -1) >= 0:
+            lib.pt_trace_end(self._nid)
 
     def __enter__(self):
         self.begin()

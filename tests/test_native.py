@@ -1,0 +1,80 @@
+"""Native layer tests via ctypes (C++ unit tests live in csrc/core_test.cc;
+these verify the Python bridge — reference pattern: pybind-level tests)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native core library not built"
+)
+
+
+def test_host_memory_stats():
+    stats = native.host_memory_stats()
+    assert "host_bytes_in_use" in stats
+
+
+def test_tcp_store_roundtrip():
+    store = native.TCPStore(is_master=True)
+    store.set("hello", "world")
+    assert store.get("hello") == b"world"
+    assert store.check("hello")
+    assert not store.check("missing")
+    assert store.add("ctr", 5) == 5
+    assert store.add("ctr", 2) == 7
+    # second client connects to the same server
+    c2 = native.TCPStore(port=store.port)
+    assert c2.get("hello") == b"world"
+    c2.close()
+    store.close()
+
+
+def test_tcp_store_barrier():
+    store = native.TCPStore(is_master=True)
+    clients = [native.TCPStore(port=store.port) for _ in range(3)]
+    import threading
+
+    done = []
+
+    def arrive(c):
+        c.barrier("b1", 4)
+        done.append(1)
+
+    threads = [threading.Thread(target=arrive, args=(c,)) for c in clients]
+    for t in threads:
+        t.start()
+    store.barrier("b1", 4)
+    for t in threads:
+        t.join(timeout=10)
+    assert len(done) == 3
+    for c in clients:
+        c.close()
+    store.close()
+
+
+def test_batch_stage_gather():
+    stage = native.BatchStage(2)
+    arr = np.arange(400, dtype=np.float32).reshape(100, 4)
+    out = stage.gather(arr, [5, 50, 99])
+    np.testing.assert_array_equal(out, arr[[5, 50, 99]])
+    # dtype/shape preserved for 3D rows
+    arr3 = np.random.rand(10, 3, 4).astype(np.float32)
+    out3 = stage.gather(arr3, [0, 9])
+    np.testing.assert_array_equal(out3, arr3[[0, 9]])
+    stage.close()
+
+
+def test_trace_export(tmp_path):
+    native.trace_enable(True)
+    with native.RecordEventNative("span"):
+        pass
+    path = str(tmp_path / "trace.json")
+    assert native.trace_export(path) == 0
+    native.trace_enable(False)
+    import json
+
+    data = json.load(open(path))
+    assert any(e["name"] == "span" for e in data["traceEvents"])

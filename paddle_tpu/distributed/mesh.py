@@ -35,6 +35,9 @@ def build_mesh(dp=1, mp=1, pp=1, sharding=1, sep=1, devices=None):
             known *= v
     if wild is not None:
         degrees[wild] = n // known
+    elif known != n and degrees["dp"] == 1 and n % known == 0:
+        # leftover devices absorb into data parallel (reference default)
+        degrees["dp"] = n // known
     total = int(np.prod([degrees[a] for a in AXIS_ORDER]))
     if total != n:
         raise ValueError(

@@ -1,0 +1,145 @@
+"""Mixture-of-Experts with expert parallelism (reference:
+python/paddle/incubate/distributed/models/moe/MoELayer — gshard/switch
+gating, capacity, alltoall dispatch — SURVEY.md §2.2 "EP").
+
+TPU-native: GShard-style dense dispatch (one_hot einsums — MXU-friendly,
+static shapes) with the expert dimension sharded over the 'ep'/'mp' mesh
+axis; XLA lowers the dispatch/combine einsums to all-to-alls across experts
+when sharded.  Aux load-balancing loss follows Switch/GShard.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..ops.dispatch import apply, coerce
+from ..distributed import mesh as _mesh
+from ..tensor import Tensor
+
+
+class TopKGate(nn.Layer):
+    """top-1 (switch) / top-2 (gshard) gate with capacity + aux loss."""
+
+    def __init__(self, d_model, num_experts, top_k=2, capacity_factor=1.25, gate_type="gshard"):
+        super().__init__()
+        self.num_experts = num_experts
+        self.top_k = 1 if gate_type == "switch" else top_k
+        self.capacity_factor = capacity_factor
+        self.wg = nn.Linear(d_model, num_experts, bias_attr=False)
+
+    def forward(self, x):
+        # returns (dispatch [tokens, E, C], combine [tokens, E, C], aux_loss)
+        logits = self.wg(x)
+        e = self.num_experts
+        k = self.top_k
+        cf = self.capacity_factor
+
+        def f(lg):
+            tokens = lg.shape[0]
+            capacity = max(int(cf * tokens * k / e), 1)
+            probs = jax.nn.softmax(lg.astype(jnp.float32), -1)  # [T, E]
+            # aux load-balance loss (GShard eq.): E * sum(me * ce)
+            me = probs.mean(0)
+            top1 = jnp.argmax(probs, -1)
+            ce = jax.nn.one_hot(top1, e, dtype=jnp.float32).mean(0)
+            aux = (me * ce).sum() * e
+
+            disp = jnp.zeros((tokens, e, capacity), jnp.float32)
+            comb = jnp.zeros((tokens, e, capacity), jnp.float32)
+            remaining = probs
+            used = jnp.zeros((e,), jnp.int32)
+            gates_accum = jnp.zeros((tokens,), jnp.float32)
+            for _ in range(k):
+                idx = jnp.argmax(remaining, -1)  # [T]
+                gate = jnp.take_along_axis(remaining, idx[:, None], 1)[:, 0]
+                sel = jax.nn.one_hot(idx, e, dtype=jnp.int32)  # [T, E]
+                pos = jnp.cumsum(sel, 0) * sel - sel + used[None, :] * sel  # [T, E]
+                slot = (pos * sel).sum(-1)  # [T]
+                fits = slot < capacity
+                onehot_slot = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
+                contrib = (
+                    sel.astype(jnp.float32)[:, :, None]
+                    * onehot_slot[:, None, :]
+                    * fits.astype(jnp.float32)[:, None, None]
+                )
+                disp = disp + contrib
+                comb = comb + contrib * gate[:, None, None]
+                used = used + (sel * fits[:, None].astype(jnp.int32)).sum(0)
+                remaining = remaining * (1.0 - sel.astype(jnp.float32))
+                gates_accum = gates_accum + gate * fits.astype(jnp.float32)
+            # normalize combine weights over selected experts
+            denom = jnp.maximum(gates_accum, 1e-9)
+            comb = comb / denom[:, None, None]
+            return disp, comb, aux
+
+        disp, comb, aux = apply(f, [coerce(logits)], multi=True, name="moe_gate")
+        return disp, comb, aux
+
+
+class ExpertFFN(nn.Layer):
+    """E experts' FFN weights as stacked tensors, expert dim shardable."""
+
+    def __init__(self, num_experts, d_model, d_hidden, activation="gelu"):
+        super().__init__()
+        self.w1 = self.create_parameter([num_experts, d_model, d_hidden], default_initializer=I.XavierNormal())
+        self.b1 = self.create_parameter([num_experts, 1, d_hidden], is_bias=True)
+        self.w2 = self.create_parameter([num_experts, d_hidden, d_model], default_initializer=I.XavierNormal())
+        self.b2 = self.create_parameter([num_experts, 1, d_model], is_bias=True)
+        self.activation = activation
+        if _mesh.axis_size("mp") > 1:
+            _mesh.shard_tensor_(self.w1, P("mp", None, None))
+            _mesh.shard_tensor_(self.b1, P("mp", None, None))
+            _mesh.shard_tensor_(self.w2, P("mp", None, None))
+            _mesh.shard_tensor_(self.b2, P("mp", None, None))
+
+    def forward(self, x):
+        """x: [E, C, d_model] → [E, C, d_model]; batched per-expert matmul."""
+        ins = [coerce(x), self.w1, self.b1, self.w2, self.b2]
+        act = jax.nn.gelu if self.activation == "gelu" else jax.nn.relu
+
+        def f(a, w1, b1, w2, b2):
+            h = jnp.einsum("ecd,edh->ech", a, w1) + b1
+            h = act(h)
+            return jnp.einsum("ech,ehd->ecd", h, w2) + b2
+
+        return apply(f, ins, name="expert_ffn")
+
+
+class MoELayer(nn.Layer):
+    """Reference API: MoELayer(gate, experts, ...); here gate config + fused
+    expert stack.  Input [B, S, D] → output [B, S, D] + aux loss stored on
+    `.aux_loss` after each forward."""
+
+    def __init__(self, d_model, d_hidden, num_experts, top_k=2, capacity_factor=1.25, gate="gshard", activation="gelu"):
+        super().__init__()
+        self.num_experts = num_experts
+        self.gate = TopKGate(d_model, num_experts, top_k, capacity_factor, gate)
+        self.experts = ExpertFFN(num_experts, d_model, d_hidden, activation)
+        self.aux_loss = None
+
+    def forward(self, x):
+        b, s, d = x.shape[0], x.shape[1], x.shape[2]
+        flat = x.reshape([b * s, d])
+        disp, comb, aux = self.gate(flat)
+        self.aux_loss = aux
+        ins = [coerce(flat), coerce(disp)]
+
+        def dispatch(a, dsp):
+            return jnp.einsum("td,tec->ecd", a, dsp.astype(a.dtype))
+
+        expert_in = apply(dispatch, ins, name="moe_dispatch")
+        spec = P("mp", None, None) if _mesh.axis_size("mp") > 1 else None
+        if spec is not None:
+            expert_in = apply(lambda a: _mesh.constraint(a, spec), [expert_in], name="moe_ep_shard")
+        expert_out = self.experts(expert_in)
+
+        def combine(eo, cmb):
+            return jnp.einsum("ecd,tec->td", eo, cmb.astype(eo.dtype))
+
+        out = apply(combine, [coerce(expert_out), coerce(comb)], name="moe_combine")
+        return out.reshape([b, s, d])

@@ -75,7 +75,11 @@ def recompute(function, *args, use_reentrant=True, **kwargs):
                     rebuilt.append(t)
                 else:
                     rebuilt.append(v)
-            out = function(*rebuilt, **kwargs)
+            # no tape inside the region: per-op jax.vjp linearization would
+            # strip custom_vjp rules (pallas flash) from the captured jaxpr;
+            # the OUTER jax AD differentiates the pure computation instead.
+            with _core.no_grad_ctx():
+                out = function(*rebuilt, **kwargs)
         finally:
             _core.set_active_trace(old)
         if isinstance(out, Tensor):

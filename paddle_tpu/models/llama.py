@@ -66,20 +66,23 @@ def _use_tp(config):
 
 
 def _rope_cache(config):
+    """cos/sin tables duplicated to full head_dim (rotate-half convention —
+    no interleave/stack temps on the hot path; HBM-friendly)."""
     dim = config.hidden_size // config.num_attention_heads
     inv_freq = 1.0 / (
         config.rope_theta ** (np.arange(0, dim, 2, dtype=np.float64) / dim)
     )
     t = np.arange(config.max_position_embeddings, dtype=np.float64)
     freqs = np.outer(t, inv_freq)
+    emb = np.concatenate([freqs, freqs], axis=-1)  # [max_pos, dim]
     return (
-        Tensor(np.cos(freqs).astype(np.float32)),
-        Tensor(np.sin(freqs).astype(np.float32)),
+        Tensor(np.cos(emb).astype(np.float32)),
+        Tensor(np.sin(emb).astype(np.float32)),
     )
 
 
 def apply_rotary_pos_emb(q, k, cos, sin, position_offset=0):
-    """q,k: [b, s, h, d]; cos/sin: [max_pos, d/2] state tensors."""
+    """q,k: [b, s, h, d]; cos/sin: [max_pos, d] state tensors (rotate-half)."""
     import jax.numpy as jnp
 
     from ..ops.dispatch import apply
@@ -87,18 +90,13 @@ def apply_rotary_pos_emb(q, k, cos, sin, position_offset=0):
     s = q.shape[1]
 
     def f(qa, ka, c, si):
-        c = c[position_offset : position_offset + s]
-        si_ = si[position_offset : position_offset + s]
-        c = c[None, :, None, :]
-        si_ = si_[None, :, None, :]
+        c = c[position_offset : position_offset + s][None, :, None, :].astype(qa.dtype)
+        si_ = si[position_offset : position_offset + s][None, :, None, :].astype(qa.dtype)
 
         def rot(x):
-            x32 = x.astype(jnp.float32)
-            x1 = x32[..., 0::2]
-            x2 = x32[..., 1::2]
-            o1 = x1 * c - x2 * si_
-            o2 = x2 * c + x1 * si_
-            return jnp.stack([o1, o2], axis=-1).reshape(x.shape).astype(x.dtype)
+            half = x.shape[-1] // 2
+            rh = jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+            return x * c + rh * si_
 
         return rot(qa), rot(ka)
 

@@ -1,0 +1,110 @@
+"""Ring attention / Ulysses / MoE tests on the 8-device CPU mesh
+(SURVEY.md §5.7 long-context mechanisms + §2.2 EP)."""
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import mesh as pmesh
+from paddle_tpu.distributed.fleet.meta_parallel.ring_attention import (
+    ring_flash_attention,
+    ulysses_attention,
+)
+from paddle_tpu.incubate.moe import MoELayer
+import paddle_tpu.nn.functional as F
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    yield
+    pmesh.set_mesh(None)
+
+
+def t(arr, rg=False):
+    return paddle.to_tensor(np.asarray(arr, np.float32), stop_gradient=not rg)
+
+
+class TestRingAttention:
+    def _ref(self, q, causal=True):
+        return F.scaled_dot_product_attention(t(q), t(q), t(q), is_causal=causal).numpy()
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_flash_on_ring(self, causal):
+        pmesh.build_mesh(sep=8)
+        np.random.seed(0)
+        q = np.random.randn(2, 64, 4, 16).astype(np.float32)
+        ref = self._ref(q, causal)
+        qt = t(q)
+        pmesh.shard_tensor_(qt, P(None, "sep", None, None))
+        out = ring_flash_attention(qt, qt, qt, causal=causal).numpy()
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+    def test_grad_flows(self):
+        pmesh.build_mesh(sep=8)
+        q = t(np.random.randn(1, 32, 2, 8), rg=True)
+        ring_flash_attention(q, q, q).sum().backward()
+        assert q.grad is not None
+        assert np.isfinite(q.grad.numpy()).all()
+
+    def test_no_mesh_fallback(self):
+        q = t(np.random.randn(1, 16, 2, 8))
+        out = ring_flash_attention(q, q, q)
+        np.testing.assert_allclose(out.numpy(), self._ref(q.numpy()), rtol=2e-4, atol=2e-5)
+
+
+class TestUlysses:
+    def test_matches_dense(self):
+        pmesh.build_mesh(sep=8)
+        np.random.seed(1)
+        q = np.random.randn(2, 64, 8, 16).astype(np.float32)  # heads divisible by 8
+        ref = F.scaled_dot_product_attention(t(q), t(q), t(q), is_causal=True).numpy()
+        qt = t(q)
+        pmesh.shard_tensor_(qt, P(None, "sep", None, None))
+        out = ulysses_attention(qt, qt, qt, causal=True).numpy()
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+class TestMoE:
+    def test_forward_shapes_and_aux(self):
+        paddle.seed(0)
+        moe = MoELayer(d_model=16, d_hidden=32, num_experts=4, top_k=2)
+        x = t(np.random.randn(2, 8, 16), rg=True)
+        out = moe(x)
+        assert out.shape == [2, 8, 16]
+        assert moe.aux_loss is not None
+        assert float(moe.aux_loss.numpy()) > 0
+
+    def test_switch_top1_routes_all_capacity(self):
+        paddle.seed(0)
+        moe = MoELayer(d_model=8, d_hidden=16, num_experts=2, top_k=1, gate="switch", capacity_factor=2.0)
+        x = t(np.random.randn(1, 16, 8))
+        out = moe(x)
+        # with generous capacity every token must be routed: output nonzero
+        assert np.abs(out.numpy()).sum() > 0
+
+    def test_trains(self):
+        paddle.seed(0)
+        moe = MoELayer(d_model=16, d_hidden=32, num_experts=4)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=moe.parameters())
+        x = t(np.random.randn(4, 8, 16))
+        y = t(np.random.randn(4, 8, 16))
+        losses = []
+        for _ in range(20):
+            out = moe(x)
+            loss = ((out - y) ** 2).mean() + 0.01 * moe.aux_loss
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_ep_sharded_experts(self):
+        pmesh.build_mesh(mp=4)
+        paddle.seed(0)
+        moe = MoELayer(d_model=16, d_hidden=32, num_experts=8)
+        shard = moe.experts.w1._raw.sharding.shard_shape(moe.experts.w1._raw.shape)
+        assert shard[0] == 2  # 8 experts / 4 devices
+        x = t(np.random.randn(2, 8, 16))
+        out = moe(x)
+        assert out.shape == [2, 8, 16]

@@ -43,6 +43,8 @@ from . import metric
 from . import device
 from . import profiler
 from . import incubate
+from . import static
+from . import inference
 from .framework.io import save, load  # noqa: F401
 from .jit import to_static  # noqa: F401
 from .hapi import Model  # noqa: F401

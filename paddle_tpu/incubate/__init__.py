@@ -6,14 +6,59 @@ from .recompute import recompute  # noqa: F401
 
 
 class nn:
+    """incubate.nn fused-op wrappers (reference: python/paddle/incubate/nn);
+    each routes to the XLA/Pallas implementation — the fusion the reference
+    hand-writes in CUDA happens in the compiler here."""
+
     class functional:
         @staticmethod
-        def fused_multi_head_attention(*a, **k):
-            raise NotImplementedError("use paddle_tpu.nn.functional.scaled_dot_product_attention (Pallas flash)")
+        def fused_multi_head_attention(x, qkv_weight, qkv_bias=None, **k):
+            raise NotImplementedError(
+                "use paddle_tpu.nn.MultiHeadAttention (routes to Pallas flash)"
+            )
 
         @staticmethod
-        def fused_feedforward(*a, **k):
-            raise NotImplementedError("XLA fuses the FFN automatically under @to_static")
+        def fused_feedforward(x, linear1_weight, linear2_weight, **k):
+            from ..nn import functional as F
+
+            h = F.linear(x, linear1_weight)
+            return F.linear(F.relu(h), linear2_weight)
+
+        @staticmethod
+        def fused_rms_norm(x, weight=None, epsilon=1e-6, **k):
+            from ..nn.functional import rms_norm
+
+            return rms_norm(x, weight, epsilon)
+
+        @staticmethod
+        def fused_layer_norm(x, weight=None, bias=None, epsilon=1e-5, **k):
+            from ..nn.functional import layer_norm
+
+            shape = [x.shape[-1]]
+            return layer_norm(x, shape, weight, bias, epsilon)
+
+        @staticmethod
+        def fused_rotary_position_embedding(q, k_, v=None, sin=None, cos=None, **kw):
+            from ..models.llama import apply_rotary_pos_emb
+
+            qo, ko = apply_rotary_pos_emb(q, k_, cos, sin)
+            return (qo, ko, v) if v is not None else (qo, ko)
+
+        @staticmethod
+        def fused_linear(x, weight, bias=None, **k):
+            from ..nn import functional as F
+
+            return F.linear(x, weight, bias)
+
+        @staticmethod
+        def swiglu(x, y=None):
+            from ..nn import functional as F
+
+            if y is None:
+                from .. import ops
+
+                x, y = ops.chunk(x, 2, axis=-1)
+            return F.silu(x) * y
 
 
 def softmax_mask_fuse_upper_triangle(x):

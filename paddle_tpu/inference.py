@@ -1,0 +1,112 @@
+"""Inference export/serving (reference capability:
+paddle/fluid/inference AnalysisPredictor + paddle.jit.save inference models —
+SURVEY.md §2.1 "Inference runtime").
+
+TPU-native path: the exported artifact is a serialized StableHLO program
+(jax.export) + weights — portable across machines with compatible jaxlib,
+re-compiled by XLA on load (the reference ships ProgramDesc + params and
+re-optimizes with IR passes; same shape).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import jax
+
+from . import no_grad
+from .framework.io import load as _load
+from .framework.io import save as _save
+from .tensor import Tensor
+
+
+def export(layer, path, example_inputs, with_weights=True):
+    """Serialize `layer.forward` traced at example_inputs to StableHLO.
+
+    example_inputs: list of Tensors/arrays defining shapes+dtypes.
+    Produces: <path>.stablehlo (serialized program), <path>.pdiparams.
+    """
+    from jax import export as jexport
+
+    layer.eval()
+    arrays = [
+        (x._raw if isinstance(x, Tensor) else np.asarray(x)) for x in example_inputs
+    ]
+
+    def pure_fn(*xs):
+        ts = []
+        for a in xs:
+            t = Tensor.__new__(Tensor)
+            t._init_from_array(a, stop_gradient=True)
+            ts.append(t)
+        with no_grad():
+            out = layer(*ts)
+        if isinstance(out, Tensor):
+            return out._raw
+        return tuple(o._raw if isinstance(o, Tensor) else o for o in out)
+
+    exported = jexport.export(jax.jit(pure_fn))(
+        *[jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrays]
+    )
+    blob = exported.serialize()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".stablehlo", "wb") as f:
+        f.write(blob)
+    if with_weights:
+        _save(layer.state_dict(), path + ".pdiparams")
+    return path
+
+
+class Config:
+    """API-compat config object (reference: paddle_infer::Config)."""
+
+    def __init__(self, model_path=None, params_path=None):
+        self.model_path = model_path
+        self.params_path = params_path
+
+    def enable_use_gpu(self, *a, **k):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+
+class Predictor:
+    """Loads a serialized StableHLO program and runs it (reference:
+    AnalysisPredictor::Run)."""
+
+    def __init__(self, path_or_config):
+        path = (
+            path_or_config.model_path
+            if isinstance(path_or_config, Config)
+            else path_or_config
+        )
+        from jax import export as jexport
+
+        with open(path + ".stablehlo", "rb") as f:
+            self._exported = jexport.deserialize(f.read())
+        self._call = self._exported.call
+
+    def run(self, inputs):
+        arrays = [
+            x._raw if isinstance(x, Tensor) else np.asarray(x) for x in inputs
+        ]
+        out = self._call(*arrays)
+        if isinstance(out, (list, tuple)):
+            return [np.asarray(o) for o in out]
+        return [np.asarray(out)]
+
+    def get_input_names(self):
+        return [f"x{i}" for i in range(len(self._exported.in_avals))]
+
+    def get_output_names(self):
+        return [f"y{i}" for i in range(len(self._exported.out_avals))]
+
+
+def create_predictor(config):
+    return Predictor(config)

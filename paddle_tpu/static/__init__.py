@@ -1,0 +1,146 @@
+"""paddle.static compatibility shim (reference: python/paddle/static/).
+
+The reference's static graph (ProgramDesc + StandaloneExecutor) maps onto
+traced XLA programs here (SURVEY.md §2.1 "Static framework": the graph IS
+the jaxpr/StableHLO traced by jit.to_static).  This shim keeps the
+Program/Executor API shape working for user code that builds a forward
+function imperatively and runs it through an Executor.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import framework
+from ..jit import InputSpec  # noqa: F401
+from ..tensor import Tensor
+
+
+class Program:
+    """Holds a python callable + captured spec instead of a ProgramDesc."""
+
+    def __init__(self):
+        self._build_fn = None
+        self.random_seed = 0
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+
+    def __enter__(self):
+        return self.main
+
+    def __exit__(self, *exc):
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Placeholder tensor: returns a zero tensor of the given spec; user
+    models built functionally should prefer dygraph + to_static."""
+    import jax.numpy as jnp
+
+    from ..framework import core as _core
+
+    shape = [1 if (s is None or s < 0) else s for s in shape]
+    t = Tensor(jnp.zeros(shape, _core.to_jax_dtype(dtype)))
+    t.name = name
+    return t
+
+
+class Executor:
+    """Runs a callable captured as the 'program' (reference:
+    StandaloneExecutor over InterpreterCore; here the program is re-executed
+    through jit-compiled steps)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        feed = feed or {}
+        if callable(getattr(program, "_build_fn", None)):
+            out = program._build_fn(**{k: Tensor(v) for k, v in feed.items()})
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return [o.numpy() if isinstance(o, Tensor) else np.asarray(o) for o in outs]
+        if fetch_list:
+            return [
+                f.numpy() if isinstance(f, Tensor) else np.asarray(f)
+                for f in fetch_list
+            ]
+        return []
+
+    def close(self):
+        pass
+
+
+def cuda_places(device_ids=None):
+    return [framework.TPUPlace(i) for i in (device_ids or [0])]
+
+
+def cpu_places(device_count=1):
+    return [framework.CPUPlace(i) for i in range(device_count)]
+
+
+def device_guard(device=None):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _guard():
+        yield
+
+    return _guard()
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, program=None):
+    raise NotImplementedError(
+        "use paddle_tpu.inference.export(model, path, example_inputs) — "
+        "serializes a StableHLO program via jax.export"
+    )
+
+
+def load_inference_model(path_prefix, executor):
+    raise NotImplementedError("use paddle_tpu.inference.Predictor(path)")
+
+
+def set_program_state(program, state):
+    pass
+
+
+class amp:
+    from ..amp import decorate as decorate  # noqa
+
+    @staticmethod
+    def auto_cast(*a, **k):
+        from ..amp import auto_cast as ac
+
+        return ac(*a, **k)
+
+
+def gradients(targets, inputs, target_gradients=None):
+    from ..autograd import grad as _grad
+
+    return _grad(targets, inputs, grad_outputs=target_gradients, retain_graph=True, allow_unused=True)
+
+
+class nn:
+    @staticmethod
+    def fc(x, size, **kwargs):
+        raise NotImplementedError("static fluid layers are superseded by paddle_tpu.nn")

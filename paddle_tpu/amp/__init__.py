@@ -80,6 +80,32 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weigh
                         opt._master_weights[id(p)] = Tensor(
                             p._data.astype(jnp.float32), stop_gradient=True
                         )
+
+    # Scope dispatch-level O2 casting to each decorated model's forward:
+    # white-listed ops cast inputs to the AMP dtype and black-listed ops
+    # (softmax/CE/norm stats) get fp32 inputs.  Without this, a decorated
+    # model relied on param dtypes alone and any fp32 leak (e.g. a norm
+    # weight) silently promoted the whole residual stream to fp32 — the
+    # round-1 bench OOM.  Wrapping forward (rather than setting a process
+    # global) keeps other models in the process at their own numerics; an
+    # explicit auto_cast(...) inside still takes precedence.
+    state = AmpState(True, dtype, "O2")
+    for model in model_list:
+        if getattr(model, "_amp_decorated", False):
+            continue
+        orig_forward = model.forward
+
+        def amp_forward(*args, __orig=orig_forward, **kwargs):
+            old = _core.set_active_amp(state)
+            try:
+                return __orig(*args, **kwargs)
+            finally:
+                _core.set_active_amp(old)
+
+        model.forward = amp_forward
+        model._amp_decorated = True
+
+    if optimizers is not None:
         return (models, optimizers)
     return models
 

@@ -585,15 +585,20 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
         ins.append(amp_cast_inputs([coerce(bias)], "black")[0])
 
     def f(a, *wb):
-        mean = jnp.mean(a, axis=naxes, keepdims=True)
-        var = jnp.var(a, axis=naxes, keepdims=True)
-        out = (a - mean) * lax.rsqrt(var + epsilon)
+        # stats in fp32, output in the activation dtype; weight/bias are cast
+        # to the activation dtype so fp32 norm params never promote the
+        # residual stream (the round-1 AMP-O2 OOM: bf16 * f32 -> f32 matmuls)
+        dtype = a.dtype
+        a32 = a.astype(jnp.float32)
+        mean = jnp.mean(a32, axis=naxes, keepdims=True)
+        var = jnp.var(a32, axis=naxes, keepdims=True)
+        out = ((a32 - mean) * lax.rsqrt(var + epsilon)).astype(dtype)
         i = 0
         if has_w:
-            out = out * wb[i]
+            out = out * wb[i].astype(dtype)
             i += 1
         if has_b:
-            out = out + wb[i]
+            out = out + wb[i].astype(dtype)
         return out
 
     return apply(f, ins, name="layer_norm")
@@ -613,7 +618,9 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None):
         out = a32 * lax.rsqrt(jnp.mean(a32 * a32, axis=-1, keepdims=True) + epsilon)
         out = out.astype(dtype)
         if w:
-            out = out * w[0]
+            # cast fp32 norm weight down — bf16 * f32 would promote the whole
+            # residual stream to f32 (round-1 AMP-O2 OOM)
+            out = out * w[0].astype(dtype)
         return out
 
     return apply(f, ins, name="rms_norm")
@@ -670,13 +677,18 @@ def batch_norm(
         ins.append(amp_cast_inputs([coerce(bias)], "black")[0])
 
     def f(a, m, v, *wb):
-        out = (a - m.reshape(shape)) * lax.rsqrt(v.reshape(shape) + epsilon)
+        dtype = a.dtype
+        a32 = a.astype(jnp.float32)
+        out = (a32 - m.reshape(shape).astype(jnp.float32)) * lax.rsqrt(
+            v.reshape(shape).astype(jnp.float32) + epsilon
+        )
+        out = out.astype(dtype)
         i = 0
         if has_w:
-            out = out * wb[i].reshape(shape)
+            out = out * wb[i].reshape(shape).astype(dtype)
             i += 1
         if has_b:
-            out = out + wb[i].reshape(shape)
+            out = out + wb[i].reshape(shape).astype(dtype)
         return out
 
     return apply(f, ins, name="batch_norm")
@@ -693,21 +705,22 @@ def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format=
         ins.append(coerce(bias))
 
     def f(a, *wb):
+        dtype = a.dtype
         n, c = a.shape[0], a.shape[1]
         spatial = a.shape[2:]
         g = num_groups
-        a2 = a.reshape((n, g, c // g) + spatial)
+        a2 = a.reshape((n, g, c // g) + spatial).astype(jnp.float32)
         axes = tuple(range(2, a2.ndim))
         mean = jnp.mean(a2, axis=axes, keepdims=True)
         var = jnp.var(a2, axis=axes, keepdims=True)
-        out = ((a2 - mean) * lax.rsqrt(var + epsilon)).reshape(a.shape)
+        out = ((a2 - mean) * lax.rsqrt(var + epsilon)).reshape(a.shape).astype(dtype)
         shape = [1, c] + [1] * len(spatial)
         i = 0
         if has_w:
-            out = out * wb[i].reshape(shape)
+            out = out * wb[i].reshape(shape).astype(dtype)
             i += 1
         if has_b:
-            out = out + wb[i].reshape(shape)
+            out = out + wb[i].reshape(shape).astype(dtype)
         return out
 
     return apply(f, ins, name="group_norm")
@@ -724,17 +737,19 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None
         ins.append(coerce(bias))
 
     def f(a, *wb):
+        dtype = a.dtype
+        a32 = a.astype(jnp.float32)
         axes = tuple(range(2, a.ndim))
-        mean = jnp.mean(a, axis=axes, keepdims=True)
-        var = jnp.var(a, axis=axes, keepdims=True)
-        out = (a - mean) * lax.rsqrt(var + eps)
+        mean = jnp.mean(a32, axis=axes, keepdims=True)
+        var = jnp.var(a32, axis=axes, keepdims=True)
+        out = ((a32 - mean) * lax.rsqrt(var + eps)).astype(dtype)
         shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
         i = 0
         if has_w:
-            out = out * wb[i].reshape(shape)
+            out = out * wb[i].reshape(shape).astype(dtype)
             i += 1
         if has_b:
-            out = out + wb[i].reshape(shape)
+            out = out + wb[i].reshape(shape).astype(dtype)
         return out
 
     return apply(f, ins, name="instance_norm")
@@ -847,42 +862,50 @@ def cross_entropy(
         ins.append(coerce(weight))
 
     def f(logits, lab, *w):
-        if use_softmax:
-            logp = jax.nn.log_softmax(logits, axis=axis)
-        else:
-            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        out_dtype = logits.dtype if jnp.issubdtype(logits.dtype, jnp.floating) else jnp.float32
+        # fp32 math expressed so XLA fuses the upcast into the reductions —
+        # never materialize a full fp32 [*, vocab] log-softmax (at vocab=32k
+        # that's a 2GB HBM temp per buffer, the round-1 bench OOM tail)
         nclass = logits.shape[axis]
+        logits32 = logits.astype(jnp.float32)
+        if use_softmax:
+            lse = jax.scipy.special.logsumexp(logits32, axis=axis, keepdims=True)
+        else:
+            lse = jnp.zeros_like(jnp.sum(logits32, axis=axis, keepdims=True))
+            logits32 = jnp.log(jnp.maximum(logits32, 1e-30))
         if soft_label:
-            tgt = lab.astype(logp.dtype)
+            tgt = lab.astype(jnp.float32)
             if label_smoothing > 0:
                 tgt = (1 - label_smoothing) * tgt + label_smoothing / nclass
-            loss = -(tgt * logp).sum(axis=axis)
-            valid = jnp.ones(loss.shape, logp.dtype)
+            # sum(tgt * (logits - lse)) fuses; tgt rows sum to 1
+            loss = -(tgt * (logits32 - lse)).sum(axis=axis)
+            valid = jnp.ones(loss.shape, jnp.float32)
         else:
             idx = lab.astype(jnp.int32)
-            if idx.ndim == logp.ndim and idx.shape[axis] == 1:
+            if idx.ndim == logits32.ndim and idx.shape[axis] == 1:
                 idx = jnp.squeeze(idx, axis)
-            valid = (idx != ignore_index).astype(logp.dtype)
+            valid = (idx != ignore_index).astype(jnp.float32)
             safe_idx = jnp.where(idx == ignore_index, 0, idx)
-            picked = jnp.take_along_axis(
-                logp, jnp.expand_dims(safe_idx, axis), axis=axis
+            picked = (
+                jnp.take_along_axis(logits32, jnp.expand_dims(safe_idx, axis), axis=axis)
+                - lse
             ).squeeze(axis)
             if label_smoothing > 0:
-                smooth = -logp.mean(axis=axis)
+                smooth = -(jnp.mean(logits32, axis=axis, keepdims=True) - lse).squeeze(axis)
                 loss = (1 - label_smoothing) * (-picked) + label_smoothing * smooth
             else:
                 loss = -picked
             loss = loss * valid
             if w:
-                cw = jnp.take(w[0], safe_idx, axis=0) * valid
-                loss = loss * jnp.take(w[0], safe_idx, axis=0)
+                cw = jnp.take(w[0], safe_idx, axis=0).astype(jnp.float32) * valid
+                loss = loss * jnp.take(w[0], safe_idx, axis=0).astype(jnp.float32)
                 if reduction == "mean":
-                    return loss.sum() / jnp.maximum(cw.sum(), 1e-12)
+                    return (loss.sum() / jnp.maximum(cw.sum(), 1e-12)).astype(out_dtype)
         if reduction == "mean":
-            return loss.sum() / jnp.maximum(valid.sum(), 1.0)
+            return (loss.sum() / jnp.maximum(valid.sum(), 1.0)).astype(out_dtype)
         if reduction == "sum":
-            return loss.sum()
-        return loss
+            return loss.sum().astype(out_dtype)
+        return loss.astype(out_dtype)
 
     return apply(f, ins, name="cross_entropy")
 

@@ -25,6 +25,10 @@ def _seeded():
     paddle.seed(1234)
     np.random.seed(1234)
     yield
+    # amp.decorate activates a persistent dispatch-level AMP state; isolate it
+    from paddle_tpu.framework import core as _core
+
+    _core.set_active_amp(None)
 
 
 def finite_difference_grad(fn, x, eps=1e-3):

@@ -1,0 +1,123 @@
+"""AMP O2 dtype-discipline tests (reference capability: paddle.amp.decorate
+pure-half training — python/paddle/amp/auto_cast.py).
+
+The round-1 bench OOM'd because fp32 norm weights promoted the bf16 residual
+stream back to fp32, so every matmul in the Llama step ran fp32.  These tests
+pin the fix: a decorated model's whole train step must contain no fp32
+dot_general (the loss/softmax path is allowed fp32 — that's the blacklist).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.tensor import Tensor
+
+
+from jax.extend import core as jex_core
+
+
+def _subjaxprs(params):
+    for v in params.values():
+        if isinstance(v, jex_core.ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, jex_core.Jaxpr):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for x in v:
+                if isinstance(x, jex_core.ClosedJaxpr):
+                    yield x.jaxpr
+                elif isinstance(x, jex_core.Jaxpr):
+                    yield x
+
+
+def _walk(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _subjaxprs(eqn.params):
+            yield from _walk(sub)
+
+
+def _f32_dots(jaxpr):
+    """dot/conv eqns whose *operands* are fp32 — fp32 accumulation
+    (preferred_element_type) over bf16 operands is fine; fp32 operands mean
+    the MXU runs at reduced rate and the activation memory doubled."""
+    bad = []
+    for eqn in _walk(jaxpr):
+        if eqn.primitive.name in ("dot_general", "conv_general_dilated"):
+            if any(
+                getattr(v.aval, "dtype", None) == jnp.float32 for v in eqn.invars
+            ):
+                bad.append(eqn)
+    return bad
+
+
+class TestAmpO2DtypeDiscipline:
+    def test_decorated_llama_step_has_no_f32_matmul(self):
+        cfg = LlamaConfig.tiny(num_hidden_layers=2)
+        model = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+        model, opt = paddle.amp.decorate(model, opt, level="O2", dtype="bfloat16")
+
+        ids_np = np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 32)).astype(np.int32)
+
+        def fwd_bwd(ids):
+            t = Tensor.__new__(Tensor)
+            t._init_from_array(ids, stop_gradient=True)
+            loss, _ = model(t, labels=t)
+            loss.backward()
+            grads = [p.grad._raw for p in model.parameters() if p.grad is not None]
+            opt.clear_grad()
+            return loss._raw, grads
+
+        jaxpr = jax.make_jaxpr(fwd_bwd)(jnp.asarray(ids_np))
+        bad = _f32_dots(jaxpr.jaxpr)
+        assert not bad, (
+            f"{len(bad)} fp32 dot_general/conv in decorated O2 step "
+            f"(first: {bad[0]})"
+        )
+
+    def test_decorated_params_are_bf16_and_norms_fp32(self):
+        cfg = LlamaConfig.tiny(num_hidden_layers=2)
+        model = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+        model, opt = paddle.amp.decorate(model, opt, level="O2", dtype="bfloat16")
+        dtypes = {n: p.dtype for n, p in model.named_parameters()}
+        norm = [d for n, d in dtypes.items() if "norm" in n.lower()]
+        dense = [d for n, d in dtypes.items() if "norm" not in n.lower()]
+        assert norm and all(d == "float32" for d in norm)
+        assert dense and all(d == "bfloat16" for d in dense)
+
+    def test_norms_do_not_promote_bf16(self):
+        import paddle_tpu.nn.functional as F
+
+        x = paddle.to_tensor(np.random.randn(2, 8, 16).astype(np.float32)).astype("bfloat16")
+        w = paddle.to_tensor(np.ones(16, np.float32))
+        b = paddle.to_tensor(np.zeros(16, np.float32))
+        assert F.rms_norm(x, w).dtype == "bfloat16"
+        assert F.layer_norm(x, 16, w, b).dtype == "bfloat16"
+
+    def test_decorated_step_trains(self):
+        cfg = LlamaConfig.tiny(num_hidden_layers=2)
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+        model, opt = paddle.amp.decorate(model, opt, level="O2", dtype="bfloat16")
+
+        @paddle.jit.to_static
+        def step(ids):
+            loss, _ = model(ids, labels=ids)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 32)).astype(np.int32)
+        )
+        losses = [float(step(ids).numpy()) for _ in range(8)]
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0], f"loss did not decrease: {losses}"

@@ -76,8 +76,8 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weigh
             if use_master:
                 opt._multi_precision = True
                 for p in opt._all_params():
-                    if p.dtype in ("float16", "bfloat16") and id(p) not in opt._master_weights:
-                        opt._master_weights[id(p)] = Tensor(
+                    if p.dtype in ("float16", "bfloat16") and opt._key(p) not in opt._master_weights:
+                        opt._master_weights[opt._key(p)] = Tensor(
                             p._data.astype(jnp.float32), stop_gradient=True
                         )
 
@@ -132,7 +132,13 @@ class GradScaler:
         self._dynamic = use_dynamic_loss_scaling
         self._good_steps = 0
         self._bad_steps = 0
-        self._found_inf = False
+        self._found_inf = None
+        # per-optimizer step state: INIT -> UNSCALED -> STEPPED, reset by
+        # update() (reference: OptimizerState in python/paddle/amp/
+        # grad_scaler.py).  Overloading _found_inf for this caused the
+        # round-1 double-unscale bug: False is both "no inf found" and
+        # "unscale_ not yet called".
+        self._optimizer_states = {}
 
     def is_enable(self):
         return self._enable
@@ -154,10 +160,18 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        st = self._optimizer_states.get(id(optimizer), "INIT")
+        if st == "UNSCALED":
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer since "
+                "the last update()."
+            )
+        if st == "STEPPED":
+            raise RuntimeError("unscale_() must be called before step().")
+        self._optimizer_states[id(optimizer)] = "UNSCALED"
         pgs = optimizer._params_grads
         if not pgs:
             return
-        grads = [g for _, g in pgs]
         inv = apply(lambda s: 1.0 / s, [self._scale])
         finite_flags = []
         for (p, g) in pgs:
@@ -171,17 +185,31 @@ class GradScaler:
         all_finite = finite_flags[0]
         for fl in finite_flags[1:]:
             all_finite = apply(lambda a, b: jnp.logical_and(a, b), [all_finite, fl])
-        self._found_inf = not bool(all_finite.numpy()) if not _is_tracing() else all_finite
+        if _is_tracing():
+            # traced flag; step() rejects this until the compiled-scaler path
+            self._found_inf = all_finite
+        else:
+            found = not bool(all_finite.numpy())
+            # OR with any inf already found this cycle (multi-optimizer
+            # pattern: a later unscale_ must not erase an earlier optimizer's
+            # detection)
+            prev = self._found_inf if isinstance(self._found_inf, bool) else False
+            self._found_inf = prev or found
         return
 
     def step(self, optimizer):
+        """Reference contract: scaler.step(opt) then scaler.update() —
+        step() skips the update when an inf/nan was found and does NOT
+        adjust the scale itself."""
         if not self._enable:
             optimizer.step()
             return
-        if not isinstance(self._found_inf, (bool,)) and self._found_inf is not None and not isinstance(self._found_inf, Tensor):
-            pass
-        if self._found_inf is False or self._found_inf is None:
-            # unscale_ not called yet
+        st = self._optimizer_states.get(id(optimizer), "INIT")
+        if st == "STEPPED":
+            raise RuntimeError(
+                "step() has already been called since the last update()."
+            )
+        if st == "INIT":
             self.unscale_(optimizer)
         if isinstance(self._found_inf, Tensor):
             raise RuntimeError(
@@ -190,29 +218,31 @@ class GradScaler:
             )
         if not self._found_inf:
             optimizer.step()
-        self.update()
+        self._optimizer_states[id(optimizer)] = "STEPPED"
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
         self.step(optimizer)
+        self.update()
 
     def update(self):
-        if not self._enable or not self._dynamic:
-            self._found_inf = None
+        if not self._enable:
             return
-        if self._found_inf:
-            self._bad_steps += 1
-            self._good_steps = 0
-            if self._bad_steps >= self._decr_every:
-                self._scale._data = self._scale._data * self._decr_ratio
-                self._bad_steps = 0
-        else:
-            self._good_steps += 1
-            self._bad_steps = 0
-            if self._good_steps >= self._incr_every:
-                self._scale._data = self._scale._data * self._incr_ratio
+        if self._dynamic:
+            if self._found_inf:
+                self._bad_steps += 1
                 self._good_steps = 0
+                if self._bad_steps >= self._decr_every:
+                    self._scale._data = self._scale._data * self._decr_ratio
+                    self._bad_steps = 0
+            else:
+                self._good_steps += 1
+                self._bad_steps = 0
+                if self._good_steps >= self._incr_every:
+                    self._scale._data = self._scale._data * self._incr_ratio
+                    self._good_steps = 0
         self._found_inf = None
+        self._optimizer_states = {}
 
     def state_dict(self):
         return {

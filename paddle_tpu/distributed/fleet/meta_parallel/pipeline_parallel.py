@@ -60,6 +60,7 @@ class PipelineParallel(Layer):
             total = loss if total is None else total + loss
         if scaler is not None:
             scaler.step(optimizer)
+            scaler.update()
         else:
             optimizer.step()
         optimizer.clear_grad()

@@ -142,7 +142,11 @@ def _ulysses_local(q, k, v, axis_name, causal, scale):
     def head2seq(x):
         b, s, h, d = x.shape
         x = x.reshape(b, n, s // n, h, d)
-        x = jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=3, tiled=False)
+        # concat_axis=2 puts the source-device axis BEFORE h_loc
+        # ([b, s_loc, n, h_loc, d]) so the reshape restores the n-major head
+        # order seq2head split with; concat_axis=3 silently permuted heads
+        # whenever num_heads > sep degree (round-1 advisor finding)
+        x = jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=False)
         return x.reshape(b, s // n, h * n, d)
 
     from ....ops.flash_attention import sdpa_array

@@ -333,6 +333,18 @@ def get_born_token(t):
     return token
 
 
+_name_counters: dict = {}
+
+
+def unique_name(prefix="tensor"):
+    """Process-wide unique name generator (reference:
+    python/paddle/utils/unique_name.py) — construction-order deterministic, so
+    names are stable across processes that build the same model."""
+    n = _name_counters.get(prefix, 0)
+    _name_counters[prefix] = n + 1
+    return f"{prefix}_{n}"
+
+
 def active_amp():
     return _mode.amp
 

@@ -49,8 +49,22 @@ class Optimizer:
         self._weight_decay = weight_decay
         self._grad_clip = grad_clip
         self._multi_precision = multi_precision
-        self._accumulators = {}  # (name, id(param)) -> Tensor
-        self._master_weights = {}  # id(param) -> fp32 Tensor
+        self._accumulators = {}  # (name, param_key) -> Tensor
+        self._master_weights = {}  # param_key -> fp32 Tensor
+        # name-keyed state requires unique names — a silent collision would
+        # share moments/master weights between distinct parameters
+        seen, dups = set(), set()
+        for p in self._all_params():
+            k = self._key(p)
+            if k in seen:
+                dups.add(k)
+            seen.add(k)
+        if dups:
+            raise ValueError(
+                f"duplicate parameter names passed to optimizer: {sorted(dups)[:5]} "
+                "— optimizer state is keyed by param.name; give parameters "
+                "unique names (auto-generated names are unique by construction)"
+            )
         self._step_count = 0
         # LR is carried in a Tensor so @to_static threads it as state instead
         # of baking a constant; refreshed from the scheduler outside traces.
@@ -61,7 +75,7 @@ class Optimizer:
         if multi_precision:
             for p in self._all_params():
                 if _is_low_precision(p):
-                    self._master_weights[id(p)] = Tensor(
+                    self._master_weights[self._key(p)] = Tensor(
                         p._data.astype(jnp.float32), stop_gradient=True
                     )
 
@@ -69,6 +83,12 @@ class Optimizer:
     def _all_params(self):
         for g in self._param_groups:
             yield from g["params"]
+
+    @staticmethod
+    def _key(p):
+        """Stable accumulator key: the param's name (construction-order
+        unique — survives checkpoint/restore across processes, unlike id())."""
+        return p.name if p.name is not None else f"id{id(p)}"
 
     @staticmethod
     def _initial_lr_value(lr):
@@ -88,11 +108,11 @@ class Optimizer:
         self._learning_rate = float(value)
 
     def _acc(self, name, p, init=None):
-        key = (name, id(p))
+        key = (name, self._key(p))
         if key not in self._accumulators:
             import jax
 
-            base = self._master_weights.get(id(p))
+            base = self._master_weights.get(self._key(p))
             ref = base if base is not None else p
             # persistent state may be first touched inside a @to_static trace:
             # build it concretely and register it for state capture
@@ -151,7 +171,7 @@ class Optimizer:
         return g_arr
 
     def _master(self, p):
-        return self._master_weights.get(id(p))
+        return self._master_weights.get(self._key(p))
 
     def _write_back(self, p, new_master):
         """Write updated fp32 value into master (if any) and the param."""
@@ -164,25 +184,75 @@ class Optimizer:
 
     # -- state ------------------------------------------------------------
     def state_dict(self):
+        """Accumulators keyed '<param_name>_<acc_name>' (the reference's
+        stable param-name keys — python/paddle/optimizer/optimizer.py) plus
+        master weights, so resume works in a fresh process."""
         sd = {}
-        for (name, pid), t in self._accumulators.items():
-            sd[f"{name}_{pid}"] = t
+        # snapshot copies: updates rebind ._data on the live Tensors, which
+        # would silently mutate an already-taken state_dict (arrays are
+        # immutable, so sharing the payload is safe)
+        for (name, pkey), t in self._accumulators.items():
+            sd[f"{pkey}_{name}"] = Tensor(t._data, stop_gradient=True)
+        if self._master_weights:
+            sd["master_weights"] = {
+                k: Tensor(v._data, stop_gradient=True)
+                for k, v in self._master_weights.items()
+            }
         sd["_step_count"] = self._step_count
         if isinstance(self._learning_rate, LRScheduler):
             sd["LR_Scheduler"] = self._learning_rate.state_dict()
         return sd
 
     def set_state_dict(self, state):
+        import warnings
+
         self._step_count = state.get("_step_count", 0)
         if isinstance(self._learning_rate, LRScheduler) and "LR_Scheduler" in state:
             self._learning_rate.set_state_dict(state["LR_Scheduler"])
-        # match accumulators positionally by (name, param order)
-        params = list(self._all_params())
-        for (name, pid), t in list(self._accumulators.items()):
-            k = f"{name}_{pid}"
-            if k in state:
-                src = state[k]
-                t._data = src._data if isinstance(src, Tensor) else jnp.asarray(src)
+
+        def _as_array(v):
+            return v._data if isinstance(v, Tensor) else jnp.asarray(v)
+
+        for pkey, mv in state.get("master_weights", {}).items():
+            self._master_weights[pkey] = Tensor(
+                _as_array(mv).astype(jnp.float32), stop_gradient=True
+            )
+
+        by_key = {self._key(p): p for p in self._all_params()}
+        unmatched = []
+        for k, v in state.items():
+            if k in ("_step_count", "LR_Scheduler", "master_weights"):
+                continue
+            # keys are '<param_name>_<acc_name>'; param names may themselves
+            # contain underscores, so take the longest param-name prefix —
+            # scan '_' positions right-to-left (dict lookups, not a scan over
+            # every param per entry)
+            pkey = None
+            pos = len(k)
+            while True:
+                pos = k.rfind("_", 0, pos)
+                if pos <= 0:
+                    break
+                if k[:pos] in by_key:
+                    pkey = k[:pos]
+                    break
+            if pkey is None:
+                unmatched.append(k)
+                continue
+            acc_name = k[len(pkey) + 1 :]
+            key = (acc_name, pkey)
+            if key in self._accumulators:
+                self._accumulators[key]._data = _as_array(v)
+            else:
+                # fresh optimizer: materialize the accumulator directly
+                t = Tensor(_as_array(v))
+                _core.unmark_born(t)
+                self._accumulators[key] = t
+        if unmatched:
+            warnings.warn(
+                f"optimizer.set_state_dict: {len(unmatched)} state entries did "
+                f"not match any parameter name and were ignored: {unmatched[:5]}"
+            )
 
 
 class SGD(Optimizer):

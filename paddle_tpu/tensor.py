@@ -356,7 +356,15 @@ class Parameter(Tensor):
     __slots__ = ("optimize_attr", "regularizer", "is_distributed", "need_clip")
 
     def __init__(self, data, dtype=None, name=None, trainable=True):
-        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        # every parameter gets a stable construction-order name — optimizer
+        # state is keyed by it (id()-keys don't survive a process restart;
+        # reference keys accumulators by param name the same way)
+        super().__init__(
+            data,
+            dtype=dtype,
+            stop_gradient=not trainable,
+            name=name or _core.unique_name("param"),
+        )
         self.persistable = True
         self._trainable = trainable
         self.optimize_attr = {"learning_rate": 1.0}

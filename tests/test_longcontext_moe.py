@@ -64,6 +64,18 @@ class TestUlysses:
         out = ulysses_attention(qt, qt, qt, causal=True).numpy()
         np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
 
+    def test_matches_dense_heads_gt_sep(self):
+        # num_heads (16) > sep degree (8): h_loc=2, so the head2seq all-to-all
+        # ordering matters — the round-1 concat_axis bug permuted heads here
+        pmesh.build_mesh(sep=8)
+        np.random.seed(2)
+        q = np.random.randn(2, 64, 16, 8).astype(np.float32)
+        ref = F.scaled_dot_product_attention(t(q), t(q), t(q), is_causal=True).numpy()
+        qt = t(q)
+        pmesh.shard_tensor_(qt, P(None, "sep", None, None))
+        out = ulysses_attention(qt, qt, qt, causal=True).numpy()
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
 
 class TestMoE:
     def test_forward_shapes_and_aux(self):

@@ -88,7 +88,7 @@ class TestOptimizers:
             opt.step()
             opt.clear_grad()
         # bf16 alone can't resolve 10 * 1e-3 steps from 1.0; master weights can
-        master = opt._master_weights[id(w)]
+        master = opt._master_weights[opt._key(w)]
         np.testing.assert_allclose(master.numpy(), [1.0 - 10e-3], rtol=1e-4)
 
     def test_state_dict_roundtrip(self):
@@ -103,6 +103,45 @@ class TestOptimizers:
         opt2.step()
         opt2.set_state_dict(sd)
         assert opt2._step_count == opt._step_count
+
+    def test_state_dict_restores_moments_across_param_objects(self):
+        # simulates checkpoint resume in a fresh process: DIFFERENT param
+        # objects, same (stable) param names — moments/beta_pows must restore
+        # by name, not by id() (ADVICE round-1 finding: id()-keys silently
+        # restored nothing)
+        from paddle_tpu.tensor import Parameter
+
+        w = Parameter(np.array([1.0], np.float32), name="resume_w")
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+        for _ in range(3):
+            (w * 2).sum().backward()
+            opt.step()
+            opt.clear_grad()
+        sd = opt.state_dict()
+        m1 = sd["resume_w_moment1"].numpy().copy()
+        assert np.abs(m1).max() > 0
+
+        # fresh process: new objects, same names, optimizer has NO
+        # accumulators yet — they must be materialized from the state
+        w2 = Parameter(np.array([5.0], np.float32), name="resume_w")
+        opt2 = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w2])
+        opt2.set_state_dict(sd)
+        assert opt2._step_count == 3
+        np.testing.assert_allclose(
+            opt2._acc("moment1", w2).numpy(), m1
+        )
+        np.testing.assert_allclose(
+            opt2._acc("beta1_pow", w2, init=0.9).numpy(),
+            sd["resume_w_beta1_pow"].numpy(),
+        )
+
+    def test_set_state_dict_warns_on_unmatched(self):
+        from paddle_tpu.tensor import Parameter
+
+        w = Parameter(np.array([1.0], np.float32), name="known_w")
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+        with pytest.warns(UserWarning, match="did not match"):
+            opt.set_state_dict({"ghost_param_moment1": np.zeros(1), "_step_count": 1})
 
 
 class TestLRSchedulers:
@@ -169,7 +208,38 @@ class TestAMP:
         scaler.scale(loss).backward()
         np.testing.assert_allclose(w.grad.numpy(), [256.0])
         scaler.step(opt)
+        scaler.update()
         np.testing.assert_allclose(w.numpy(), [0.8], rtol=1e-5)
+
+    def test_grad_scaler_explicit_unscale_then_step(self):
+        # the documented unscale -> clip -> step pattern must not divide the
+        # grads by the scale twice (ADVICE round-1 finding)
+        w = t(np.array([1.0]), rg=True)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+        loss = (w * 3).sum()
+        scaler.scale(loss).backward()
+        scaler.unscale_(opt)
+        np.testing.assert_allclose(w.grad.numpy(), [3.0])
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(w.numpy(), [0.7], rtol=1e-5)
+
+    def test_grad_scaler_double_unscale_raises(self):
+        w = t(np.array([1.0]), rg=True)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler()
+        scaler.scale((w * 2).sum()).backward()
+        scaler.unscale_(opt)
+        with pytest.raises(RuntimeError, match="already been called"):
+            scaler.unscale_(opt)
+        scaler.step(opt)
+        with pytest.raises(RuntimeError, match="already been called"):
+            scaler.step(opt)
+        scaler.update()  # resets — next cycle works
+        scaler.scale((w * 2).sum()).backward()
+        scaler.step(opt)
+        scaler.update()
 
     def test_grad_scaler_skips_on_inf(self):
         w = t(np.array([1.0]), rg=True)
@@ -178,6 +248,7 @@ class TestAMP:
         loss = (w * np.float32(np.inf)).sum()
         scaler.scale(loss).backward()
         scaler.step(opt)
+        scaler.update()
         np.testing.assert_allclose(w.numpy(), [1.0])  # skipped
         assert float(scaler.get_loss_scaling().numpy()) == pytest.approx(2.0)
 

@@ -2,18 +2,47 @@
 python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:
 train_batch with 1F1B / interleaved schedules over NCCL p2p).
 
-Round-1 TPU-native execution: `train_batch` runs the microbatch loop with
-gradient accumulation; each microbatch's fwd+bwd executes in the current
-(optionally step-compiled) program, and stage weights may be 'pp'-sharded so
-XLA overlaps cross-stage transfer with compute.  The explicit
-ppermute-per-stage 1F1B schedule is the M6 milestone (SURVEY.md §7)."""
+TPU-native execution model (single controller): the 1F1B order is realized
+as the *emission order* of per-stage forward/backward computations — under
+@to_static the whole schedule traces into ONE XLA program whose op order is
+the 1F1B order, stage weights live on their 'pp' mesh shard, and XLA's
+latency-hiding scheduler overlaps the cross-stage transfers (ICI) with
+compute; eagerly, async dispatch gives the same overlap.  Activation
+lifetime follows the schedule: at most (warmup+1) microbatches of
+activations are live per stage — the 1F1B memory contract — because each
+microbatch's tape is dropped right after its backward.
+
+Schedules:
+- "F-then-B"  : all forwards, then all backwards (GPipe-style; round-1 path)
+- "1F1B"      : warmup/steady/cooldown per stage (default for pp > 1)
+- interleaved : num_virtual_pipeline_stages > 1 chunks the layer list into
+  p*v virtual stages (chunk c on physical stage c % p, Megatron placement)
+  and runs 1F1B over the virtual-stage chain.
+
+The emitted order is recorded in `last_schedule` (list of
+("F"|"B", stage_chunk, microbatch)) so tests can assert real pipelining
+(microbatches in flight > 1), mirroring the reference's schedule tests.
+"""
 
 from __future__ import annotations
 
+from ....autograd import backward as _autograd_backward
 from ....nn.layer import Layer
 from ....ops.manipulation import split as _split
 from ..topology import get_hybrid_communicate_group
 from .pp_layers import PipelineLayer
+
+
+def _build_1f1b_sequence(num_chunks, chunk_id, n_micro):
+    """Local op sequence for one (virtual) stage: F*warmup, (F,B)*steady,
+    B*cooldown (reference: pipeline_parallel.py 1F1B phases)."""
+    warm = min(num_chunks - chunk_id - 1, n_micro)
+    seq = ["F"] * warm
+    for _ in range(n_micro - warm):
+        seq.append("F")
+        seq.append("B")
+    seq.extend(["B"] * warm)
+    return seq
 
 
 class PipelineParallel(Layer):
@@ -26,16 +55,138 @@ class PipelineParallel(Layer):
         self._strategy = strategy
         acc = 1
         micro = 1
+        mode = "1F1B"
         if strategy is not None:
             cfg = getattr(strategy, "pipeline_configs", None)
             if cfg:
                 acc = cfg.get("accumulate_steps", 1)
                 micro = cfg.get("micro_batch_size", 1)
+                mode = cfg.get("schedule_mode", "1F1B")
         self.accumulate_steps = acc
         self.micro_batch_size = micro
+        self.schedule_mode = mode
+        self.last_schedule = []
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
+
+    # -- schedule executors ------------------------------------------------
+
+    def _run_chunk(self, chunk, x):
+        for layer, fwd in self._layers.chunk_functions(chunk):
+            if fwd is not None:
+                x = fwd(layer, x)
+            else:
+                x = layer(x)
+        return x
+
+    def _train_1f1b(self, xs, ys, scaler):
+        """Event-driven 1F1B over the (virtual-)stage chain.
+
+        Dependencies: F(c, i) needs F(c-1, i); B(c, i) needs B(c+1, i)
+        (last chunk: its own F).  Each round-robin pass lets every chunk
+        emit at most one ready op, which interleaves chunks the way the
+        distributed timeline does."""
+        n_micro = len(xs)
+        n_chunks = self._layers.num_chunks
+        seqs = [_build_1f1b_sequence(n_chunks, c, n_micro) for c in range(n_chunks)]
+        # microbatches complete strictly in index order per chunk, so
+        # next_f/next_b fully encode progress: F(c, i) done <=> i < next_f[c]
+        pc = [0] * n_chunks
+        next_f = [0] * n_chunks
+        next_b = [0] * n_chunks
+        # per (chunk, mb) saved state
+        stage_in = {}
+        stage_out = {}
+        losses = {}
+        cots = {}
+        events = []
+        total = None
+
+        def run_f(c, i):
+            nonlocal total
+            if c == 0:
+                x_in = xs[i]
+            else:
+                # detached copy feeds this chunk; the ORIGINAL stays in
+                # stage_out until B(c-1, i) backwards through its tape
+                x_in = stage_out[(c - 1, i)].detach()
+                x_in.stop_gradient = False
+            out = self._run_chunk(c, x_in)
+            if c == n_chunks - 1:
+                loss = self._layers.loss(out, ys[i]) / n_micro
+                total = loss.detach() if total is None else total + loss.detach()
+                losses[(c, i)] = scaler.scale(loss) if scaler is not None else loss
+            else:
+                stage_out[(c, i)] = out
+            if c > 0:
+                stage_in[(c, i)] = x_in
+
+        def run_b(c, i):
+            if c == n_chunks - 1:
+                losses.pop((c, i)).backward()
+            else:
+                out = stage_out.pop((c, i))
+                _autograd_backward([out], [cots.pop((c, i))])
+            if c > 0:
+                x_in = stage_in.pop((c, i))
+                cots[(c - 1, i)] = x_in.grad
+                x_in.grad = None
+
+        remaining = sum(len(s) for s in seqs)
+        while remaining:
+            progressed = False
+            for c in range(n_chunks):
+                if pc[c] >= len(seqs[c]):
+                    continue
+                op = seqs[c][pc[c]]
+                if op == "F":
+                    i = next_f[c]
+                    if c > 0 and i >= next_f[c - 1]:
+                        continue
+                    run_f(c, i)
+                    next_f[c] += 1
+                else:
+                    i = next_b[c]
+                    if c < n_chunks - 1 and i >= next_b[c + 1]:
+                        continue
+                    if i >= next_f[c]:
+                        continue
+                    run_b(c, i)
+                    next_b[c] += 1
+                events.append((op, c, i))
+                pc[c] += 1
+                remaining -= 1
+                progressed = True
+            if not progressed:
+                raise RuntimeError(
+                    "1F1B schedule deadlocked (internal error): "
+                    f"pc={pc} next_f={next_f} next_b={next_b}"
+                )
+        # backward of a non-last chunk with an unconsumed stage_out for a
+        # later chunk would leak; all queues must drain
+        assert not stage_out and not stage_in and not losses and not cots
+        self.last_schedule = events
+        return total
+
+    def _train_f_then_b(self, xs, ys, scaler):
+        n_micro = len(xs)
+        total = None
+        events = []
+        for i, (xi, yi) in enumerate(zip(xs, ys)):
+            out = self._layers(xi)
+            loss = self._layers.loss(out, yi) / n_micro
+            events.append(("F", 0, i))
+            if scaler is not None:
+                scaler.scale(loss).backward()
+            else:
+                loss.backward()
+            events.append(("B", 0, i))
+            total = loss.detach() if total is None else total + loss.detach()
+        self.last_schedule = events
+        return total
+
+    # -- public API --------------------------------------------------------
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         x, y = data
@@ -46,18 +197,17 @@ class PipelineParallel(Layer):
             ys = _split(y, n_micro, axis=0)
         else:
             xs, ys = [x], [y]
-            n_micro = 1
 
-        total = None
-        for xi, yi in zip(xs, ys):
-            out = self._layers(xi)
-            loss = self._layers.loss(out, yi)
-            loss = loss / n_micro
-            if scaler is not None:
-                scaler.scale(loss).backward()
-            else:
-                loss.backward()
-            total = loss if total is None else total + loss
+        use_1f1b = (
+            self.schedule_mode in ("1F1B", "VPP")
+            and self._layers.num_chunks > 1
+            and len(xs) > 1
+        )
+        if use_1f1b:
+            total = self._train_1f1b(xs, ys, scaler)
+        else:
+            total = self._train_f_then_b(xs, ys, scaler)
+
         if scaler is not None:
             scaler.step(optimizer)
             scaler.update()
@@ -66,7 +216,7 @@ class PipelineParallel(Layer):
         optimizer.clear_grad()
         if lr_scheduler is not None:
             lr_scheduler.step()
-        return total.detach()
+        return total
 
     def eval_batch(self, data, compute_loss=True):
         x, y = data

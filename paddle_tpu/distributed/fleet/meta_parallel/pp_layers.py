@@ -58,6 +58,7 @@ class PipelineLayer(Layer):
         self._topo = topology
         hcg = get_hybrid_communicate_group()
         self._num_stages = num_stages or hcg.get_pipe_parallel_world_size()
+        self._num_virtual = num_virtual_pipeline_stages or 1
         self._recompute_interval = recompute_interval
 
         self._layers_desc = list(layers)
@@ -79,17 +80,30 @@ class PipelineLayer(Layer):
         self.run_function = built
         self._sublist = LayerList([l for l, _ in built if isinstance(l, Layer)])
 
-        # stage segmentation (kept for introspection/parity)
+        # chunk segmentation: num_stages * num_virtual chunks; with
+        # num_virtual > 1, chunk c runs on physical stage c % num_stages
+        # (Megatron interleaved placement — reference pp_layers.py
+        # _construct_shared_comm / get_stage_from_index)
         n = len(built)
-        per = max(1, math.ceil(n / self._num_stages))
+        total = self._num_stages * self._num_virtual
+        per = max(1, math.ceil(n / total))
         self._segments = [
-            (i * per, min((i + 1) * per, n)) for i in range(self._num_stages)
+            (i * per, min((i + 1) * per, n)) for i in range(total)
         ]
 
+    @property
+    def num_chunks(self):
+        """Virtual-stage chain length (== num_stages when not interleaved)."""
+        return len(self._segments)
+
+    def chunk_functions(self, chunk):
+        lo, hi = self._segments[chunk]
+        return self.run_function[lo:hi]
+
     def get_stage_from_index(self, index):
-        for sid, (lo, hi) in enumerate(self._segments):
+        for cid, (lo, hi) in enumerate(self._segments):
             if lo <= index < hi:
-                return sid
+                return cid % self._num_stages
         return self._num_stages - 1
 
     def forward(self, x):

@@ -188,7 +188,7 @@ class _LNPipe(nn.LayerNorm):
 class GPTForCausalLMPipe(PipelineLayer):
     """Pipeline variant (reference: GPTForPretrainingPipe with LayerDesc)."""
 
-    def __init__(self, config, num_stages=None, loss_fn=None):
+    def __init__(self, config, num_stages=None, loss_fn=None, num_virtual_pipeline_stages=None):
         self.config = config
         descs = [LayerDesc(_EmbeddingPipe, config)]
         for _ in range(config.num_hidden_layers):
@@ -201,4 +201,9 @@ class GPTForCausalLMPipe(PipelineLayer):
                 logits.reshape([-1, config.vocab_size]), labels.reshape([-1])
             )
 
-        super().__init__(descs, num_stages=num_stages, loss_fn=loss_fn or default_loss)
+        super().__init__(
+            descs,
+            num_stages=num_stages,
+            loss_fn=loss_fn or default_loss,
+            num_virtual_pipeline_stages=num_virtual_pipeline_stages,
+        )

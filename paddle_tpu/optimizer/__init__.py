@@ -87,8 +87,13 @@ class Optimizer:
     @staticmethod
     def _key(p):
         """Stable accumulator key: the param's name (construction-order
-        unique — survives checkpoint/restore across processes, unlike id())."""
-        return p.name if p.name is not None else f"id{id(p)}"
+        unique — survives checkpoint/restore across processes, unlike id()).
+        Unnamed trainable tensors get a name assigned on first use so their
+        state_dict keys are restorable too (an id()-based key could never
+        match in a fresh process)."""
+        if p.name is None:
+            p.name = _core.unique_name("tensor_param")
+        return p.name
 
     @staticmethod
     def _initial_lr_value(lr):

@@ -5,48 +5,117 @@ stage 1/2/3 + GroupShardedStage{2,3} — SURVEY.md §2.2 "Sharding").
 TPU-native: ZeRO == laying out optimizer state / gradients / parameters with
 NamedShardings over the 'sharding' mesh axis and letting GSPMD insert the
 reduce-scatter/all-gather pairs inside the compiled step:
-  stage 1 — optimizer accumulators sharded;
-  stage 2 — + gradients sharded (grad outputs constrained);
-  stage 3 — + parameters sharded (gathered on use automatically).
+  stage 1 ('os')     — optimizer accumulators + master weights sharded;
+  stage 2 ('os_g')   — + gradients constrained to the axis at step time
+                       (reduce-scatter semantics: each shard owns 1/n of
+                       every gradient);
+  stage 3 ('p_g_os') — + parameters sharded, gathered on use by GSPMD.
+
+Sharding is applied AT CREATION (the optimizer's accumulator factory is
+wrapped), not after the first step — the round-1 version sharded only after
+step(), so the first optimizer step ran with replicated state and compiled
+steps could silently lose the layout.
+
+`offload=True` maps optimizer state to host memory via JAX memory kinds
+(TPU only); on backends without pinned-host support it raises rather than
+silently ignoring the flag.
 """
 
 from __future__ import annotations
 
 import jax
+from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from ..nn.layer import Layer
 from ..tensor import Tensor
 from . import mesh as _mesh
 
-
-def _shardable(arr, n):
-    return arr.ndim >= 1 and arr.shape and arr.shape[0] % n == 0 and arr.shape[0] >= n
+_AXIS = "sharding"
 
 
-def _shard_over_axis(t, axis="sharding"):
+def _spec_for(shape, n, axis=_AXIS):
+    """Shard the first dim when divisible; replicate otherwise (the
+    reference shards flattened param groups; we keep param shapes and skip
+    indivisible ones — small tensors gain nothing from sharding)."""
+    if len(shape) >= 1 and shape and shape[0] % n == 0 and shape[0] >= n:
+        return P(axis)
+    return None
+
+
+def _sharding_for(spec, offload=False):
+    mesh = _mesh.get_mesh()
+    if mesh is None:
+        return None
+    sh = NamedSharding(mesh, spec)
+    if offload:
+        sh = sh.with_memory_kind("pinned_host")
+    return sh
+
+
+def _place(t, offload=False, axis=_AXIS):
+    """Apply the sharded layout to a concrete Tensor."""
     n = _mesh.axis_size(axis)
     if n <= 1 or isinstance(t._raw, jax.core.Tracer):
         return
-    if _shardable(t._raw, n):
-        _mesh.shard_tensor_(t, P(axis))
+    spec = _spec_for(t._raw.shape, n, axis)
+    if spec is None and not offload:
+        return
+    sh = _sharding_for(spec or P(), offload)
+    if sh is not None:
+        t._data = jax.device_put(t._raw, sh)
+
+
+def _constrain(arr, axis=_AXIS):
+    """Constrain a traced array to the sharding axis (compiled-step path:
+    GSPMD turns the gradient psum into reduce-scatter + keeps it sharded)."""
+    mesh = _mesh.get_mesh()
+    n = _mesh.axis_size(axis)
+    if mesh is None or n <= 1:
+        return arr
+    spec = _spec_for(arr.shape, n, axis)
+    if spec is None:
+        return arr
+    return jax.lax.with_sharding_constraint(arr, NamedSharding(mesh, spec))
 
 
 class _ShardedOptimizerWrapper:
-    def __init__(self, optimizer, level):
+    def __init__(self, optimizer, level, offload=False):
         self._inner = optimizer
         self._level = level
+        self._offload = offload
+        # shard accumulators AT CREATION: the factory runs under
+        # ensure_compile_time_eval, so the tensor is concrete even when
+        # first touched inside a @to_static trace
+        orig_acc = optimizer._acc
+
+        def sharded_acc(name, p, init=None, __orig=orig_acc):
+            fresh = (name, optimizer._key(p)) not in optimizer._accumulators
+            t = __orig(name, p, init)
+            if fresh:
+                _place(t, self._offload)
+            return t
+
+        optimizer._acc = sharded_acc
 
     def __getattr__(self, item):
         return getattr(self._inner, item)
 
+    def shard_gradients(self):
+        """Stage >= 2: constrain every gradient to the sharding axis.
+        Traced: with_sharding_constraint (reduce-scatter inside the step);
+        eager: device_put (each host shard owns 1/n of the grad)."""
+        if self._level not in ("os_g", "p_g_os"):
+            return
+        for p, g in self._inner._params_grads:
+            if isinstance(g._raw, jax.core.Tracer):
+                g._data = _constrain(g._raw)
+            else:
+                _place(g)
+
     def step(self):
+        self.shard_gradients()
         self._inner.step()
-        # lazily created accumulators get sharded after first step
-        for acc in self._inner._accumulators.values():
-            _shard_over_axis(acc)
-        for mw in self._inner._master_weights.values():
-            _shard_over_axis(mw)
 
     def clear_grad(self, *a, **k):
         self._inner.clear_grad()
@@ -93,18 +162,28 @@ def group_sharded_parallel(
     if _mesh.get_mesh() is None:
         _mesh.build_mesh(sharding=-1)
 
+    if offload:
+        # pinned-host memory kinds exist on TPU; reject elsewhere instead of
+        # silently training without offload (round-1 ignored the flag)
+        backend = jax.default_backend()
+        if backend != "tpu":
+            raise NotImplementedError(
+                f"offload=True requires TPU host memory kinds; backend is "
+                f"'{backend}'. Run without offload or on TPU."
+            )
+
     if level == "p_g_os":
         for p in model.parameters():
-            _shard_over_axis(p)
+            # params stay in device HBM (they're used every layer); GSPMD
+            # all-gathers shards on use
+            _place(p, offload=False)
     for acc in optimizer._accumulators.values():
-        _shard_over_axis(acc)
+        _place(acc, offload)
     for mw in optimizer._master_weights.values():
-        _shard_over_axis(mw)
+        _place(mw, offload)
 
-    opt = _ShardedOptimizerWrapper(optimizer, level)
+    opt = _ShardedOptimizerWrapper(optimizer, level, offload)
     wrapped = _ShardedModelWrapper(model, level) if level != "os" else model
-    if scaler is not None:
-        return wrapped, opt, scaler
     return wrapped, opt, scaler
 
 

@@ -104,14 +104,28 @@ class _ShardedOptimizerWrapper:
     def shard_gradients(self):
         """Stage >= 2: constrain every gradient to the sharding axis.
         Traced: with_sharding_constraint (reduce-scatter inside the step);
-        eager: device_put (each host shard owns 1/n of the grad)."""
+        eager: device_put (each host shard owns 1/n of the grad).
+
+        Writes go back through `p.grad` so the sharded array lands on the
+        PARAMETER's grad slot (`Tensor.grad` returns a fresh wrapper on every
+        access — mutating the wrapper, as round 2 did, sharded a temporary
+        and left the real gradient replicated)."""
         if self._level not in ("os_g", "p_g_os"):
             return
-        for p, g in self._inner._params_grads:
+        n = _mesh.axis_size(_AXIS)
+        if n <= 1:
+            return
+        for p in self._inner._all_params():
+            if p.stop_gradient:
+                continue
+            g = p.grad
+            if g is None:
+                continue
             if isinstance(g._raw, jax.core.Tracer):
-                g._data = _constrain(g._raw)
+                p.grad = _constrain(g._raw)
             else:
-                _place(g)
+                _place(g)  # rebinds the wrapper's _raw (no trace is active)
+                p.grad = g._raw
 
     def step(self):
         self.shard_gradients()

@@ -52,7 +52,7 @@ def _run_refreshers():
 class _Trace:
     """State-slot interception for one traced call (phase = discover|execute)."""
 
-    __slots__ = ("phase", "overlay", "reads", "writes", "subst", "token")
+    __slots__ = ("phase", "overlay", "reads", "writes", "subst", "token", "pins")
 
     def __init__(self, phase, subst=None):
         self.phase = phase
@@ -61,12 +61,19 @@ class _Trace:
         self.writes = {}
         self.subst = subst or {}
         self.token = object()
+        # Slots are keyed by id(tensor); temporaries (e.g. the fresh wrapper
+        # Tensor.grad returns) can die mid-trace and their ids get reused by
+        # later tensors, silently aliasing two different slots.  Pin every
+        # tensor that touches a slot for the lifetime of the trace (cleared
+        # once the trace finishes — see _trace()).
+        self.pins = {}
 
     @staticmethod
     def _slot_value(t, kind):
         return t._raw if kind == "data" else t._grad_raw
 
     def read(self, t, kind):
+        self.pins[id(t)] = t
         key = (id(t), kind)
         if key in self.overlay:
             return self.overlay[key]
@@ -85,6 +92,7 @@ class _Trace:
         return val
 
     def write(self, t, kind, value):
+        self.pins[id(t)] = t
         key = (id(t), kind)
         self.overlay[key] = value
         if _core.get_born_token(t) is not self.token:
@@ -174,6 +182,12 @@ class StaticFunction:
             return tuple(t._raw for t in sink)
 
         jax.eval_shape(discover_wrapper, in_structs)
+        # `runner` closes over `discover` (for .writes) and is retained by the
+        # cached jitted entry — drop the pins so the discover trace's
+        # intermediate tensors (and their tape) don't live forever.  The
+        # (t, kind) tuples in reads/writes keep the persistent tensors alive,
+        # which is what keeps their id-derived keys valid.
+        discover.pins.clear()
 
         state_in = list(discover.reads.values())
         write_keys = set(discover.writes.keys())

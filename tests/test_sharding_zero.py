@@ -74,7 +74,8 @@ class TestZeroStages:
                 shard = p._raw.sharding.shard_shape(p._raw.shape)
                 assert shard[0] == p._raw.shape[0] // 8, p.name
         # gather-on-use: forward over sharded params matches the dense run
-        np.testing.assert_allclose(model2(x).numpy(), ref_out, rtol=1e-6)
+        # (rtol 1e-5: sharded matmuls reduce in a different order than dense)
+        np.testing.assert_allclose(model2(x).numpy(), ref_out, rtol=1e-5, atol=1e-6)
 
     @pytest.mark.parametrize("level", ["os", "os_g", "p_g_os"])
     def test_step_parity_vs_unsharded(self, level):

@@ -2,15 +2,17 @@
 python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:
 train_batch with 1F1B / interleaved schedules over NCCL p2p).
 
-TPU-native execution model (single controller): the 1F1B order is realized
-as the *emission order* of per-stage forward/backward computations — under
-@to_static the whole schedule traces into ONE XLA program whose op order is
-the 1F1B order, stage weights live on their 'pp' mesh shard, and XLA's
-latency-hiding scheduler overlaps the cross-stage transfers (ICI) with
-compute; eagerly, async dispatch gives the same overlap.  Activation
-lifetime follows the schedule: at most (warmup+1) microbatches of
-activations are live per stage — the 1F1B memory contract — because each
-microbatch's tape is dropped right after its backward.
+This class is the SCHEDULER path: the 1F1B order is realized as the
+*emission order* of per-stage forward/backward computations in one program.
+Weights here are NOT placed on the pp mesh axis — every device holds all
+stages (useful for schedule correctness, debugging, and small models).
+The on-mesh execution path — stage weights sharded P('pp'), ppermute
+activation handoff over ICI, microbatching inside one differentiable
+program — is `pp_spmd.pipeline_apply` (used by e.g.
+models.gpt.GPTForCausalLMSpmdPipe).  Activation lifetime here follows the
+schedule: at most (warmup+1) microbatches of activations are live per
+stage — the 1F1B memory contract — because each microbatch's tape is
+dropped right after its backward.
 
 Schedules:
 - "F-then-B"  : all forwards, then all backwards (GPipe-style; round-1 path)

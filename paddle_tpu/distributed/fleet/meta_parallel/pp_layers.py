@@ -2,11 +2,12 @@
 python/paddle/distributed/fleet/meta_parallel/parallel_layers/pp_layers.py:
 LayerDesc / SharedLayerDesc / PipelineLayer — SURVEY.md §2.2 "PP").
 
-Round-1 TPU-native execution model: the stage partition (LayerDesc list →
-segments) is preserved; microbatched execution with gradient accumulation
-runs inside ONE compiled program, and stage weights can be sharded over the
-'pp' mesh axis.  A ppermute-based 1F1B schedule over per-stage programs is
-the planned optimization (SURVEY.md §7 M6) — the user API is already final.
+The stage partition (LayerDesc list → segments) is preserved; microbatched
+execution with gradient accumulation runs inside ONE program with weights
+replicated across devices (scheduler path — see pipeline_parallel.py).
+For stage weights physically sharded over the 'pp' axis with ppermute
+p2p, use the homogeneous stacked-weight path (pp_spmd.pipeline_apply /
+models.gpt.GPTForCausalLMSpmdPipe).
 """
 
 from __future__ import annotations

@@ -6,9 +6,12 @@ same layer classes over mp/pp mesh axes; pre-norm GPT-3 architecture."""
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 
 import numpy as np
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from .. import nn, ops
 from ..distributed import mesh as _mesh
@@ -19,7 +22,14 @@ from ..distributed.fleet.meta_parallel import (
     RowParallelLinear,
     VocabParallelEmbedding,
 )
+from ..distributed.fleet.meta_parallel.pp_spmd import (
+    pipeline_apply,
+    place_stacked_param,
+)
 from ..nn import functional as F
+from ..nn import initializer as I
+from ..ops.dispatch import apply as _dispatch_apply
+from ..ops.flash_attention import sdpa_array
 from ..tensor import Tensor
 
 
@@ -175,6 +185,217 @@ class GPTForCausalLM(nn.Layer):
 
 
 GPTForPretraining = GPTForCausalLM
+
+
+def _ln_f32(x, w, b, eps):
+    """LayerNorm with fp32 statistics (the AMP-O2 norm contract)."""
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    y = (xf - mu) / jnp.sqrt(var + eps)
+    return (y * w + b).astype(x.dtype)
+
+
+# field order is the wire format between GPTStackedDecoder and its block fn
+_STACKED_FIELDS = (
+    "ln1_w", "ln1_b", "qkv_w", "qkv_b", "out_w", "out_b",
+    "ln2_w", "ln2_b", "fc1_w", "fc1_b", "fc2_w", "fc2_b",
+)
+
+# mp (TP) sharding of the non-layer dims, per field; layer dim is always 'pp'
+_STACKED_EXTRA_SPECS = {
+    "qkv_w": (None, "mp"), "qkv_b": ("mp",),
+    "fc1_w": (None, "mp"), "fc1_b": ("mp",),
+    "out_w": ("mp", None), "fc2_w": ("mp", None),
+}
+
+
+def _stacked_block(lp, h, num_heads, eps):
+    """One pre-norm decoder layer, functional form. lp: tuple of per-layer
+    arrays in _STACKED_FIELDS order (no leading layer dim); h: [mb, S, H]."""
+    (ln1_w, ln1_b, qkv_w, qkv_b, out_w, out_b,
+     ln2_w, ln2_b, fc1_w, fc1_b, fc2_w, fc2_b) = lp
+    mb, s, hid = h.shape
+    head_dim = hid // num_heads
+
+    y = _ln_f32(h, ln1_w, ln1_b, eps)
+    qkv = y @ qkv_w.astype(y.dtype) + qkv_b.astype(y.dtype)
+    qkv = qkv.reshape(mb, s, 3, num_heads, head_dim)
+    # TP composes: heads shard over the (auto) mp axis inside the manual-pp
+    # region; attention is head-parallel so GSPMD keeps it local.  Every
+    # constraint keeps 'dp' on the batch dim — dropping it would make GSPMD
+    # all-gather activations over dp per layer.
+    qkv = _mesh.constraint(qkv, P("dp", None, None, "mp", None))
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    att = sdpa_array(q, k, v, causal=True)  # [mb, S, heads, hd]
+    att = att.reshape(mb, s, hid)
+    out = att @ out_w.astype(att.dtype) + out_b.astype(att.dtype)
+    out = _mesh.constraint(out, P("dp", None, None))  # mp partial -> replicated
+    h = h + out
+
+    y = _ln_f32(h, ln2_w, ln2_b, eps)
+    f = y @ fc1_w.astype(y.dtype) + fc1_b.astype(y.dtype)
+    f = _mesh.constraint(f, P("dp", None, "mp"))
+    import jax
+
+    f = jax.nn.gelu(f, approximate=True)
+    o = f @ fc2_w.astype(f.dtype) + fc2_b.astype(f.dtype)
+    o = _mesh.constraint(o, P("dp", None, None))
+    return h + o
+
+
+class GPTStackedDecoder(nn.Layer):
+    """All decoder blocks as STACKED parameters [n_layers, ...] sharded
+    P('pp') on the layer dim — each pp coordinate physically holds only its
+    own stages' weights (per-device parameter bytes ~ total/pp), and forward
+    runs the shard_map+ppermute pipeline (pp_spmd.pipeline_apply).
+
+    Reference counterpart: per-rank PipelineLayer segments +
+    p2p_communication (SURVEY.md §2.2 PP); here stage placement is a named
+    sharding and p2p is lax.ppermute over ICI.
+    """
+
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        L, h, inter = (
+            config.num_hidden_layers,
+            config.hidden_size,
+            config.intermediate_size,
+        )
+        w = I.Normal(std=0.02)
+        one = I.Constant(1.0)
+        zero = I.Constant(0.0)
+        mk = lambda shape, init: self.create_parameter(list(shape), default_initializer=init)
+        self.ln1_w = mk((L, h), one)
+        self.ln1_b = mk((L, h), zero)
+        self.qkv_w = mk((L, h, 3 * h), w)
+        self.qkv_b = mk((L, 3 * h), zero)
+        self.out_w = mk((L, h, h), w)
+        self.out_b = mk((L, h), zero)
+        self.ln2_w = mk((L, h), one)
+        self.ln2_b = mk((L, h), zero)
+        self.fc1_w = mk((L, h, inter), w)
+        self.fc1_b = mk((L, inter), zero)
+        self.fc2_w = mk((L, inter, h), w)
+        self.fc2_b = mk((L, h), zero)
+        # stage placement: layer dim over 'pp'; matmul weights also over 'mp'
+        for name in _STACKED_FIELDS:
+            place_stacked_param(getattr(self, name), _STACKED_EXTRA_SPECS.get(name, ()))
+
+    def forward(self, x, n_micro=1, remat=True):
+        params = [getattr(self, name) for name in _STACKED_FIELDS]
+        fn = self._pipeline_fn(n_micro, remat)
+        return _dispatch_apply(fn, [x] + params, name="gpt_pp_pipeline")
+
+    def _pipeline_fn(self, n_micro, remat):
+        """jitted pipeline entry, cached per (n_micro, remat, mesh).
+
+        The jit wrapper is required even for the eager path: partial-manual
+        shard_map (axis_names={'pp'}) only stages under jit in current JAX —
+        its eager impl path rejects specs that leave auto axes out."""
+        cache = self.__dict__.setdefault("_pipe_cache", {})
+        # the Mesh object itself is the key component (hashable; holding it
+        # strongly also prevents id-reuse aliasing after build_mesh())
+        key = (n_micro, remat, _mesh.get_mesh())
+        fn = cache.get(key)
+        if fn is None:
+            import jax
+
+            cfg = self.config
+            block = functools.partial(
+                _stacked_block,
+                num_heads=cfg.num_attention_heads,
+                eps=cfg.layer_norm_epsilon,
+            )
+
+            def raw(x_arr, *leaves):
+                return pipeline_apply(block, tuple(leaves), x_arr, n_micro, remat=remat)
+
+            fn = jax.jit(raw)
+            cache[key] = fn
+        return fn
+
+    def load_from_layers(self, layers):
+        """Stack per-layer weights from a list of GPTDecoderLayer (parity
+        harness: the dense model and the pipelined model share weights)."""
+        def stack(get):
+            return np.stack([np.asarray(get(l)._raw) for l in layers])
+
+        self.ln1_w._data = jnp.asarray(stack(lambda l: l.ln_1.weight))
+        self.ln1_b._data = jnp.asarray(stack(lambda l: l.ln_1.bias))
+        self.qkv_w._data = jnp.asarray(stack(lambda l: l.attn.qkv_proj.weight))
+        self.qkv_b._data = jnp.asarray(stack(lambda l: l.attn.qkv_proj.bias))
+        self.out_w._data = jnp.asarray(stack(lambda l: l.attn.out_proj.weight))
+        self.out_b._data = jnp.asarray(stack(lambda l: l.attn.out_proj.bias))
+        self.ln2_w._data = jnp.asarray(stack(lambda l: l.ln_2.weight))
+        self.ln2_b._data = jnp.asarray(stack(lambda l: l.ln_2.bias))
+        self.fc1_w._data = jnp.asarray(stack(lambda l: l.mlp.fc1.weight))
+        self.fc1_b._data = jnp.asarray(stack(lambda l: l.mlp.fc1.bias))
+        self.fc2_w._data = jnp.asarray(stack(lambda l: l.mlp.fc2.weight))
+        self.fc2_b._data = jnp.asarray(stack(lambda l: l.mlp.fc2.bias))
+        for name in _STACKED_FIELDS:
+            place_stacked_param(getattr(self, name), _STACKED_EXTRA_SPECS.get(name, ()))
+
+
+class GPTForCausalLMSpmdPipe(nn.Layer):
+    """Config-5 flagship: GPT with DP x TP x PP in ONE compiled program.
+
+    Embedding / final-LN / head run in the auto-sharded (dp, mp) world;
+    the decoder stack runs the pp-pipelined schedule.  Microbatching and
+    gradient accumulation are inside the differentiable forward, so
+    `loss = model(ids, labels); loss.backward(); opt.step()` is a complete
+    pipeline-parallel training step (and compiles under @to_static).
+    """
+
+    def __init__(self, config, num_micro_batches=1):
+        super().__init__()
+        if config.hidden_dropout_prob or config.attention_probs_dropout_prob:
+            raise NotImplementedError(
+                "GPTForCausalLMSpmdPipe does not implement dropout inside the "
+                "pipelined decoder stack; set hidden_dropout_prob and "
+                "attention_probs_dropout_prob to 0 (or use GPTForCausalLM)."
+            )
+        self.config = config
+        self.num_micro_batches = num_micro_batches
+        self.embeddings = GPTEmbeddings(config)
+        self.blocks = GPTStackedDecoder(config)
+        self.ln_f = nn.LayerNorm(config.hidden_size, config.layer_norm_epsilon)
+        if _use_tp(config):
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False, gather_output=True
+            )
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size, bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        x = self.embeddings(input_ids)
+        x = self.blocks(x, n_micro=self.num_micro_batches,
+                        remat=self.config.use_recompute or self.training)
+        x = self.ln_f(x)
+        logits = self.lm_head(x)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.config.vocab_size]), labels.reshape([-1])
+            )
+            return loss, logits
+        return logits
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """Reference-shaped convenience (PipelineParallel.train_batch)."""
+        x, y = data
+        loss, _ = self(x, y)
+        if scaler is not None:
+            scaler.scale(loss).backward()
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            loss.backward()
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
 
 
 class _EmbeddingPipe(GPTEmbeddings):

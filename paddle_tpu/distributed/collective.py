@@ -4,10 +4,25 @@ paddle/fluid/distributed/collective/ + python/paddle/distributed/communication/
 
 TPU-native `ProcessGroupXLA` stance: a "group" is a set of mesh axes.  Inside
 compiled/shard_map regions the collectives lower to XLA collectives over ICI
-(psum / all_gather / reduce_scatter / all_to_all / ppermute); eagerly on
-sharded arrays the same semantics are obtained by resharding (XLA inserts the
-transfers).  Async Task handles exist for API parity — XLA's async dispatch
-already overlaps communication, so wait() is a sync point.
+(psum / all_gather / reduce_scatter / all_to_all / ppermute).  Async Task
+handles exist for API parity — XLA's async dispatch already overlaps
+communication, so wait() is a sync point.
+
+Eager (concrete-array) semantics, single controller: the multi-process
+"per-rank tensor of shape [s]" is encoded as ONE global array whose
+group-axis-sharded dim is [n*s] (shard r = rank r's value).  Under that
+encoding the collectives are real reductions/slices executed by XLA across
+the mesh:
+  all_reduce   [.., n*s, ..] axis-sharded -> [.., s, ..] reduced, replicated
+  all_gather   axis-sharded -> the n blocks, each replicated
+  broadcast    axis-sharded -> block `src` replicated (shape [.., s, ..])
+On arrays REPLICATED over the group axis, every rank holds the same value,
+so all_reduce(SUM) genuinely multiplies by n (the no-op identity round 2
+shipped was silently wrong), MAX/MIN/AVG are identity, and broadcast is a
+true no-op.  Where single-controller semantics do not exist (eager
+reduce_scatter / scatter of per-rank-distinct inputs, collectives on a
+multi-process world with no mesh axis) the API RAISES instead of returning
+the input unchanged.
 """
 
 from __future__ import annotations
@@ -128,17 +143,93 @@ def _in_named_trace(axis):
         return False
 
 
+def _axis_dim(arr, axis_name):
+    """Dim of `arr` sharded over mesh axis `axis_name` (None if replicated
+    or unsharded).  Concrete arrays only."""
+    if isinstance(arr, jax.core.Tracer) or axis_name is None:
+        return None
+    sh = getattr(arr, "sharding", None)
+    if not isinstance(sh, NamedSharding):
+        return None
+    if sh.mesh.shape.get(axis_name, 1) <= 1:
+        return None
+    for d, entry in enumerate(sh.spec):
+        names = entry if isinstance(entry, tuple) else (entry,)
+        if axis_name in names:
+            return d
+    return None
+
+
+def _no_traced_encoding(t, api, axis, n):
+    """Inside @to_static the payload may be a Tracer whose sharding is
+    unknowable, so the per-rank encoding cannot be detected — refuse rather
+    than silently apply replicated semantics (which round 2's no-ops did)."""
+    if (
+        n > 1
+        and axis is not None
+        and not _in_named_trace(axis)
+        and isinstance(t._data, jax.core.Tracer)
+    ):
+        raise RuntimeError(
+            f"{api} on a traced intermediate cannot infer the per-rank "
+            "encoding; call it eagerly on concrete tensors, inside shard_map "
+            "(lax collectives), or express the reduction with mesh sharding "
+            "constraints so GSPMD inserts it"
+        )
+
+
+def _require_single_controller(api):
+    """Eager collectives with no mesh axis are only correct when this
+    process sees the whole job; on a multi-process (jax.distributed) run
+    they would silently compute per-host garbage — refuse."""
+    if jax.process_count() > 1:
+        raise RuntimeError(
+            f"eager {api} on a {jax.process_count()}-process job needs a "
+            "group bound to a mesh axis (new_group(axis_name=...) or the "
+            "fleet topology groups); the axis-less eager path is "
+            "single-controller only"
+        )
+
+
 # ---------------------------------------------------------------------------
 # collectives
 # ---------------------------------------------------------------------------
 
 
+_REDUCERS = {
+    ReduceOp.SUM: jnp.sum,
+    ReduceOp.MAX: jnp.max,
+    ReduceOp.MIN: jnp.min,
+    ReduceOp.AVG: jnp.mean,
+    ReduceOp.PROD: jnp.prod,
+}
+
+
+def _blocks_view(a, d, n):
+    """Reshape dim `d` of size n*s into (n, s): per-rank blocks."""
+    s = a.shape[d] // n
+    if a.shape[d] % n:
+        raise ValueError(
+            f"collective input dim {d} ({a.shape[d]}) not divisible by group size {n}"
+        )
+    return a.reshape(a.shape[:d] + (n, s) + a.shape[d + 1 :]), s
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     g = _get_group(group)
     axis = g.axis_name
+    t = coerce(tensor)
+    n = g.nranks
+    in_named = axis is not None and _in_named_trace(axis)
+    if not in_named:
+        _no_traced_encoding(t, "all_reduce", axis, n)
+    # sharding inspected OUTSIDE the traced fn: inside jax.vjp / @to_static
+    # the payload is a Tracer with no sharding, which would silently take
+    # the replicated branch on a sharded input
+    d = None if in_named else _axis_dim(t._raw, axis)
 
     def f(a):
-        if axis is not None and _in_named_trace(axis):
+        if in_named:
             if op == ReduceOp.SUM:
                 return jax.lax.psum(a, axis)
             if op == ReduceOp.MAX:
@@ -148,14 +239,23 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
             if op == ReduceOp.AVG:
                 return jax.lax.pmean(a, axis)
             raise ValueError(op)
-        # eager / GSPMD: data parallel arrays are sharded on a batch axis —
-        # a replicated constraint makes XLA insert the reduction; a fully
-        # replicated array is already "reduced" across the group
-        return a
+        if d is not None:
+            # per-rank blocks live on the axis shards: reduce them for real
+            blocks, _ = _blocks_view(a, d, n)
+            return _REDUCERS[op](blocks, axis=d)
+        # replicated over the group: every rank holds the same value
+        if n <= 1:
+            return a
+        _require_single_controller("all_reduce")
+        if op == ReduceOp.SUM:
+            return a * n
+        if op == ReduceOp.PROD:
+            return a**n
+        return a  # MAX/MIN/AVG of n equal values
 
-    out = apply(f, [coerce(tensor)], name="all_reduce")
+    out = apply(f, [t], name="all_reduce")
     inplace_rebind(tensor, out)
-    return Task([tensor]) if not sync_op else Task([tensor])
+    return Task([tensor])
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
@@ -170,7 +270,16 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
         )
         parts = [out[i] for i in range(n)]
     else:
-        parts = [t.clone() for _ in range(n)]
+        d = _axis_dim(t._raw, aname)
+        if d is not None:
+            # the axis shards ARE the per-rank tensors; slice them out
+            from ..ops.manipulation import split as _split
+
+            parts = _split(t, n, axis=d)
+        else:
+            if n > 1:
+                _require_single_controller("all_gather")
+            parts = [t.clone() for _ in range(n)]
     if tensor_list is not None:
         tensor_list.clear()
         tensor_list.extend(parts)
@@ -201,15 +310,68 @@ def reduce_scatter(tensor, tensor_list_or_tensor, op=ReduceOp.SUM, group=None, s
         )
     else:
         n = g.nranks
-        r = g.rank if g.rank >= 0 else 0
-        size = src.shape[0] // max(n, 1)
-        out = src[r * size : (r + 1) * size]
+        _no_traced_encoding(src, "reduce_scatter", aname, n)
+        if n <= 1:
+            out = src
+        elif _axis_dim(src._raw, aname) is None and aname is not None:
+            # replicated input: every rank contributes the same [n*s] array,
+            # so rank r's result is n * block_r — the full per-rank-distinct
+            # result is the scaled array laid out on the axis shards
+            def f(a):
+                if a.shape[0] % n:
+                    raise ValueError(
+                        f"reduce_scatter dim0 ({a.shape[0]}) not divisible by {n}"
+                    )
+                return a * n
+
+            out = apply(f, [src], name="reduce_scatter")
+            sh = _mesh.sharding_for(P(aname))
+            if sh is not None and not isinstance(out._raw, jax.core.Tracer):
+                out._data = jax.device_put(out._raw, sh)
+        else:
+            raise NotImplementedError(
+                "eager reduce_scatter of per-rank-distinct inputs has no "
+                "single-controller encoding; run it inside shard_map/@to_static "
+                "(GSPMD lowers the sharding constraint to reduce-scatter), or "
+                "pass a group-replicated input"
+            )
     inplace_rebind(tensor, out)
     return Task([tensor])
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
-    # single-controller: arrays are already consistent; in shard_map use ppermute
+    g = _get_group(group)
+    aname = g.axis_name
+    t = coerce(tensor)
+    n = g.nranks
+
+    srel = g.get_group_rank(src) if g.ranks is not None else src
+    if srel < 0 or srel >= n:
+        raise ValueError(f"broadcast src rank {src} is not in the group")
+
+    if aname is not None and _in_named_trace(aname):
+        # inside shard_map: everyone takes rank `src`'s value
+        out = apply(
+            lambda a: jax.lax.all_gather(a, aname, axis=0)[srel],
+            [t],
+            name="broadcast",
+        )
+        inplace_rebind(tensor, out)
+        return Task([tensor])
+
+    _no_traced_encoding(t, "broadcast", aname, n)
+    d = _axis_dim(t._raw, aname)
+    if d is not None:
+        # per-rank-distinct blocks: select rank src's block, replicated
+        def f(a):
+            blocks, _ = _blocks_view(a, d, n)
+            return jax.lax.index_in_dim(blocks, srel, axis=d, keepdims=False)
+
+        inplace_rebind(tensor, apply(f, [t], name="broadcast"))
+        return Task([tensor])
+    if n > 1:
+        _require_single_controller("broadcast")
+    # replicated single-controller arrays are already consistent: true no-op
     return Task([tensor])
 
 
@@ -223,9 +385,26 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     g = _get_group(group)
+    n = g.nranks
     if tensor_list:
-        r = g.rank if g.rank >= 0 else 0
-        inplace_rebind(tensor, coerce(tensor_list[min(r, len(tensor_list) - 1)]))
+        if n > 1 and g.axis_name is not None:
+            if len(tensor_list) != n:
+                raise ValueError(
+                    f"scatter needs len(tensor_list) == group size ({n}), "
+                    f"got {len(tensor_list)}"
+                )
+            # per-rank-distinct result == the stacked list laid out on the
+            # group axis (the single-controller encoding)
+            from ..ops.manipulation import concat
+
+            out = concat([coerce(x) for x in tensor_list], axis=0)
+            sh = _mesh.sharding_for(P(g.axis_name))
+            if sh is not None and not isinstance(out._raw, jax.core.Tracer):
+                out._data = jax.device_put(out._raw, sh)
+            inplace_rebind(tensor, out)
+        else:
+            r = g.rank if g.rank >= 0 else 0
+            inplace_rebind(tensor, coerce(tensor_list[min(r, len(tensor_list) - 1)]))
     return Task([tensor])
 
 
@@ -242,8 +421,15 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
             name="alltoall",
         )
         parts = [out[i] for i in range(len(in_tensor_list))]
-    else:
+    elif g.nranks <= 1:
         parts = [coerce(t) for t in in_tensor_list]
+    else:
+        raise NotImplementedError(
+            "eager alltoall produces a per-rank-distinct result with no "
+            "single-controller encoding; run it inside shard_map/@to_static "
+            "(jax.lax.all_to_all), or see meta_parallel.ring_attention for "
+            "the sep-axis pattern"
+        )
     out_tensor_list.clear()
     out_tensor_list.extend(parts)
     return Task(parts)
@@ -261,8 +447,12 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=
             [t],
             name="alltoall_single",
         )
-    else:
+    elif g.nranks <= 1:
         out = t
+    else:
+        raise NotImplementedError(
+            "eager alltoall_single: see distributed.collective.alltoall"
+        )
     inplace_rebind(out_tensor, out)
     return Task([out_tensor])
 

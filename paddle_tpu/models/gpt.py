@@ -10,6 +10,7 @@ import functools
 from dataclasses import dataclass
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -236,8 +237,6 @@ def _stacked_block(lp, h, num_heads, eps):
     y = _ln_f32(h, ln2_w, ln2_b, eps)
     f = y @ fc1_w.astype(y.dtype) + fc1_b.astype(y.dtype)
     f = _mesh.constraint(f, P("dp", None, "mp"))
-    import jax
-
     f = jax.nn.gelu(f, approximate=True)
     o = f @ fc2_w.astype(f.dtype) + fc2_b.astype(f.dtype)
     o = _mesh.constraint(o, P("dp", None, None))
@@ -300,8 +299,6 @@ class GPTStackedDecoder(nn.Layer):
         key = (n_micro, remat, _mesh.get_mesh())
         fn = cache.get(key)
         if fn is None:
-            import jax
-
             cfg = self.config
             block = functools.partial(
                 _stacked_block,

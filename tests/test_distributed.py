@@ -173,13 +173,90 @@ class TestCollectives:
         out = fn(x)
         assert float(out[0]) == 28.0
 
-    def test_collective_api_world1_semantics(self):
+    def test_eager_allreduce_really_sums_shards(self):
+        """Per-rank-distinct input (axis-sharded blocks) -> real reduction.
+        The round-2 no-op (all_reduce(x) == x) is exactly what this pins
+        against."""
+        pmesh.build_mesh(dp=8)
+        g = paddle.distributed.new_group(axis_name="dp")
+        # rank r's tensor = [r, r] -> global [16] sharded over dp
+        x = t(np.repeat(np.arange(8.0), 2))
+        pmesh.shard_tensor_(x, P("dp"))
+        paddle.distributed.all_reduce(x, group=g)
+        np.testing.assert_allclose(x.numpy(), [28.0, 28.0])
+
+        x = t(np.repeat(np.arange(8.0), 2))
+        pmesh.shard_tensor_(x, P("dp"))
+        paddle.distributed.all_reduce(x, op=paddle.distributed.ReduceOp.MAX, group=g)
+        np.testing.assert_allclose(x.numpy(), [7.0, 7.0])
+
+    def test_eager_allreduce_grad_tracked_same_semantics(self):
+        """stop_gradient=False must not change collective semantics (the
+        sharding check has to happen outside the vjp-traced fn)."""
+        pmesh.build_mesh(dp=8)
+        g = paddle.distributed.new_group(axis_name="dp")
+        x = t(np.repeat(np.arange(8.0), 2), rg=True)
+        pmesh.shard_tensor_(x, P("dp"))
+        paddle.distributed.all_reduce(x, group=g)
+        np.testing.assert_allclose(x.numpy(), [28.0, 28.0])
+
+    def test_eager_broadcast_bad_src_raises(self):
+        pmesh.build_mesh(dp=8)
+        g = paddle.distributed.new_group(ranks=[0, 1], axis_name="dp")
+        x = t(np.ones(4))
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="not in the group"):
+            paddle.distributed.broadcast(x, src=5, group=g)
+
+    def test_eager_allreduce_replicated_multiplies(self):
+        """Replicated over the group => every rank holds x, so SUM is n*x."""
+        pmesh.build_mesh(dp=8)
+        g = paddle.distributed.new_group(axis_name="dp")
+        x = t(np.ones(4))
+        paddle.distributed.all_reduce(x, group=g)
+        np.testing.assert_allclose(x.numpy(), 8 * np.ones(4))
+        y = t(np.full(4, 3.0))
+        paddle.distributed.all_reduce(y, op=paddle.distributed.ReduceOp.MAX, group=g)
+        np.testing.assert_allclose(y.numpy(), np.full(4, 3.0))
+
+    def test_eager_allgather_slices_shards(self):
+        pmesh.build_mesh(dp=8)
+        g = paddle.distributed.new_group(axis_name="dp")
+        x = t(np.arange(16.0))
+        pmesh.shard_tensor_(x, P("dp"))
+        outs = []
+        paddle.distributed.all_gather(outs, x, group=g)
+        assert len(outs) == 8
+        for r, o in enumerate(outs):
+            np.testing.assert_allclose(o.numpy(), [2.0 * r, 2.0 * r + 1])
+
+    def test_eager_broadcast_selects_src_block(self):
+        pmesh.build_mesh(dp=8)
+        g = paddle.distributed.new_group(axis_name="dp")
+        x = t(np.arange(16.0))
+        pmesh.shard_tensor_(x, P("dp"))
+        paddle.distributed.broadcast(x, src=3, group=g)
+        np.testing.assert_allclose(x.numpy(), [6.0, 7.0])
+
+    def test_eager_reduce_scatter_replicated(self):
+        pmesh.build_mesh(dp=8)
+        g = paddle.distributed.new_group(axis_name="dp")
+        src = t(np.arange(16.0))
+        out = t(np.zeros(16))
+        paddle.distributed.reduce_scatter(out, src, group=g)
+        # every rank contributed the same array: block r scaled by n, laid
+        # out on the axis shards
+        np.testing.assert_allclose(out.numpy(), 8 * np.arange(16.0))
+        shard = out._raw.sharding.shard_shape(out._raw.shape)
+        assert shard == (2,)
+
+    def test_eager_world1_identity(self):
+        """No mesh, single process: world is 1 rank, identity is correct."""
+        pmesh.set_mesh(None)
         x = t(np.ones(4))
         paddle.distributed.all_reduce(x)
         np.testing.assert_allclose(x.numpy(), np.ones(4))
-        outs = []
-        paddle.distributed.all_gather(outs, x)
-        assert len(outs) >= 1
 
 
 class TestAutoParallelAPI:

@@ -111,7 +111,15 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weigh
 
 
 class GradScaler:
-    """Dynamic loss scaling (reference: python/paddle/amp/grad_scaler.py)."""
+    """Dynamic loss scaling (reference: python/paddle/amp/grad_scaler.py).
+
+    Works eagerly AND inside @to_static: found_inf, the loss scale, and the
+    good/bad step counters are device state (Tensors), the skip-on-inf is a
+    jnp.where select over every optimizer state write, and the scale/counter
+    update is on-device arithmetic — so the whole fp16 train step compiles
+    into one XLA program with no host round-trip (the reference reaches the
+    same with update_loss_scaling_op in the static graph).
+    """
 
     def __init__(
         self,
@@ -123,22 +131,36 @@ class GradScaler:
         decr_every_n_nan_or_inf=1,
         use_dynamic_loss_scaling=True,
     ):
+        import jax
+
         self._enable = enable
-        self._scale = Tensor(jnp.asarray(init_loss_scaling, jnp.float32))
+        with jax.ensure_compile_time_eval():
+            self._scale = Tensor(jnp.asarray(init_loss_scaling, jnp.float32))
+            self._good_steps = Tensor(jnp.asarray(0, jnp.int32))
+            self._bad_steps = Tensor(jnp.asarray(0, jnp.int32))
+        for t in (self._scale, self._good_steps, self._bad_steps):
+            _core.unmark_born(t)  # persistent even if constructed mid-trace
         self._incr_ratio = incr_ratio
         self._decr_ratio = decr_ratio
         self._incr_every = incr_every_n_steps
         self._decr_every = decr_every_n_nan_or_inf
         self._dynamic = use_dynamic_loss_scaling
-        self._good_steps = 0
-        self._bad_steps = 0
-        self._found_inf = None
+        self._found_inf = None  # None | Tensor(bool scalar) — eager cycle
         # per-optimizer step state: INIT -> UNSCALED -> STEPPED, reset by
         # update() (reference: OptimizerState in python/paddle/amp/
         # grad_scaler.py).  Overloading _found_inf for this caused the
         # round-1 double-unscale bug: False is both "no inf found" and
-        # "unscale_ not yet called".
-        self._optimizer_states = {}
+        # "unscale_ not yet called".  Weak keys: a scaler outliving its
+        # optimizers must not pin them (round-2 id()-keying leaked).
+        import weakref
+
+        self._optimizer_states = weakref.WeakKeyDictionary()
+        # Traced cycles are namespaced PER TRACE PHASE (keyed weakly by the
+        # trace token): @to_static runs the fn twice (discover + execute),
+        # and host state carried across phases would make the execute pass
+        # see the discover pass's STEPPED markers / leaked tracers.
+        self._trace_cycles = weakref.WeakKeyDictionary()
+        self._pending_traced_update = False
 
     def is_enable(self):
         return self._enable
@@ -157,10 +179,52 @@ class GradScaler:
             return var
         return apply(lambda a, s: a * s.astype(a.dtype), [coerce(var), self._scale], name="scale_loss")
 
+    # -- cycle state (eager: on self; traced: per trace token) -------------
+    class _Cycle:
+        __slots__ = ("states", "found")
+
+        def __init__(self):
+            self.states = {}  # id(optimizer) -> INIT/UNSCALED/STEPPED
+            self.found = None
+
+    def _cycle(self):
+        tr = _core.active_trace()
+        if tr is None:
+            return None
+        c = self._trace_cycles.get(tr)
+        if c is None:
+            c = GradScaler._Cycle()
+            self._trace_cycles[tr] = c
+        return c
+
+    def _get_state(self, optimizer):
+        c = self._cycle()
+        if c is not None:
+            return c.states.get(id(optimizer), "INIT")
+        return self._optimizer_states.get(optimizer, "INIT")
+
+    def _set_state(self, optimizer, st):
+        c = self._cycle()
+        if c is not None:
+            c.states[id(optimizer)] = st
+        else:
+            self._optimizer_states[optimizer] = st
+
+    def _get_found(self):
+        c = self._cycle()
+        return c.found if c is not None else self._found_inf
+
+    def _set_found(self, v):
+        c = self._cycle()
+        if c is not None:
+            c.found = v
+        else:
+            self._found_inf = v
+
     def unscale_(self, optimizer):
         if not self._enable:
             return
-        st = self._optimizer_states.get(id(optimizer), "INIT")
+        st = self._get_state(optimizer)
         if st == "UNSCALED":
             raise RuntimeError(
                 "unscale_() has already been called on this optimizer since "
@@ -168,7 +232,7 @@ class GradScaler:
             )
         if st == "STEPPED":
             raise RuntimeError("unscale_() must be called before step().")
-        self._optimizer_states[id(optimizer)] = "UNSCALED"
+        self._set_state(optimizer, "UNSCALED")
         pgs = optimizer._params_grads
         if not pgs:
             return
@@ -185,17 +249,16 @@ class GradScaler:
         all_finite = finite_flags[0]
         for fl in finite_flags[1:]:
             all_finite = apply(lambda a, b: jnp.logical_and(a, b), [all_finite, fl])
-        if _is_tracing():
-            # traced flag; step() rejects this until the compiled-scaler path
-            self._found_inf = all_finite
+        found_now = apply(lambda a: jnp.logical_not(a), [all_finite], name="found_inf")
+        prev = self._get_found()
+        if prev is None:
+            self._set_found(found_now)
         else:
-            found = not bool(all_finite.numpy())
-            # OR with any inf already found this cycle (multi-optimizer
-            # pattern: a later unscale_ must not erase an earlier optimizer's
-            # detection)
-            prev = self._found_inf if isinstance(self._found_inf, bool) else False
-            self._found_inf = prev or found
-        return
+            # multi-optimizer pattern: a later unscale_ must not erase an
+            # earlier optimizer's detection
+            self._set_found(
+                apply(lambda a, b: jnp.logical_or(a, b), [prev, found_now])
+            )
 
     def step(self, optimizer):
         """Reference contract: scaler.step(opt) then scaler.update() —
@@ -204,21 +267,48 @@ class GradScaler:
         if not self._enable:
             optimizer.step()
             return
-        st = self._optimizer_states.get(id(optimizer), "INIT")
+        st = self._get_state(optimizer)
         if st == "STEPPED":
             raise RuntimeError(
                 "step() has already been called since the last update()."
             )
         if st == "INIT":
             self.unscale_(optimizer)
-        if isinstance(self._found_inf, Tensor):
-            raise RuntimeError(
-                "GradScaler with dynamic host-side skipping is not supported inside "
-                "@to_static; use bf16 AMP (no scaler) for compiled steps."
-            )
-        if not self._found_inf:
+        found = self._get_found()
+        if found is None:
+            optimizer.step()  # no grads were unscaled (empty param list)
+        elif _is_tracing():
+            self._guarded_step(optimizer, found)
+            self._pending_traced_update = True
+        elif bool(found.numpy()):
+            pass  # skip: inf/nan in grads
+        else:
             optimizer.step()
-        self._optimizer_states[id(optimizer)] = "STEPPED"
+        self._set_state(optimizer, "STEPPED")
+
+    def _guarded_step(self, optimizer, found):
+        """Traced skip-on-inf: run the update, then select old-vs-new for
+        every optimizer state write with jnp.where(found_inf, old, new) —
+        the whole thing stays inside the compiled program (lax.select, no
+        host branch)."""
+        # Accumulators are fully materialized by the time the EXECUTE phase
+        # (the pass whose jaxpr becomes the program) runs — the discover
+        # phase already ran the same Python and created them at their init
+        # values — so this snapshot covers every state write, including a
+        # skipped first step leaving fresh moments at init.
+        snap = [
+            (p, p._data)
+            for p in optimizer._all_params()
+            if not p.stop_gradient
+        ]
+        snap += [(t, t._data) for t in optimizer._master_weights.values()]
+        snap += [(t, t._data) for t in optimizer._accumulators.values()]
+        optimizer.step()
+        skip = found._data
+        for t, old in snap:
+            new = t._data
+            if new is not old:
+                t._data = jnp.where(skip, old, new)
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
@@ -228,21 +318,48 @@ class GradScaler:
     def update(self):
         if not self._enable:
             return
-        if self._dynamic:
-            if self._found_inf:
-                self._bad_steps += 1
-                self._good_steps = 0
-                if self._bad_steps >= self._decr_every:
-                    self._scale._data = self._scale._data * self._decr_ratio
-                    self._bad_steps = 0
-            else:
-                self._good_steps += 1
-                self._bad_steps = 0
-                if self._good_steps >= self._incr_every:
-                    self._scale._data = self._scale._data * self._incr_ratio
-                    self._good_steps = 0
-        self._found_inf = None
-        self._optimizer_states = {}
+        c = self._cycle()
+        found = c.found if c is not None else self._found_inf
+        if c is None and found is None and self._pending_traced_update:
+            self._pending_traced_update = False  # one-shot: eager cycles resume
+            raise RuntimeError(
+                "scaler.step() ran inside a @to_static function but "
+                "scaler.update() was called outside it; with compiled steps, "
+                "call update() inside the same compiled function so the "
+                "scale/counters update on-device."
+            )
+        if self._dynamic and found is not None:
+            incr_r, decr_r = self._incr_ratio, self._decr_ratio
+            incr_n, decr_n = self._incr_every, self._decr_every
+
+            def f(found, scale, good, bad):
+                bad_n = jnp.where(found, bad + 1, jnp.zeros_like(bad))
+                good_n = jnp.where(found, jnp.zeros_like(good), good + 1)
+                dec = bad_n >= decr_n
+                inc = good_n >= incr_n
+                scale_n = jnp.where(
+                    dec, scale * decr_r, jnp.where(inc, scale * incr_r, scale)
+                )
+                bad_n = jnp.where(dec, jnp.zeros_like(bad_n), bad_n)
+                good_n = jnp.where(inc, jnp.zeros_like(good_n), good_n)
+                return scale_n, good_n, bad_n
+
+            s, gd, bd = apply(
+                f,
+                [found, self._scale, self._good_steps, self._bad_steps],
+                multi=True,
+                name="update_loss_scaling",
+            )
+            self._scale._data = s._data
+            self._good_steps._data = gd._data
+            self._bad_steps._data = bd._data
+        if c is not None:
+            c.found = None
+            c.states.clear()
+            self._pending_traced_update = False
+        else:
+            self._found_inf = None
+            self._optimizer_states.clear()
 
     def state_dict(self):
         return {
@@ -251,16 +368,16 @@ class GradScaler:
             "decr_ratio": self._decr_ratio,
             "incr_every_n_steps": self._incr_every,
             "decr_every_n_nan_or_inf": self._decr_every,
-            "good_steps": self._good_steps,
-            "bad_steps": self._bad_steps,
+            "good_steps": int(self._good_steps.numpy()),
+            "bad_steps": int(self._bad_steps.numpy()),
         }
 
     def load_state_dict(self, state):
         import numpy as np
 
         self._scale._data = jnp.asarray(np.asarray(state["scale"]), jnp.float32)
-        self._good_steps = state.get("good_steps", 0)
-        self._bad_steps = state.get("bad_steps", 0)
+        self._good_steps._data = jnp.asarray(state.get("good_steps", 0), jnp.int32)
+        self._bad_steps._data = jnp.asarray(state.get("bad_steps", 0), jnp.int32)
 
 
 def _is_tracing():

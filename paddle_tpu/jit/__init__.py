@@ -52,7 +52,7 @@ def _run_refreshers():
 class _Trace:
     """State-slot interception for one traced call (phase = discover|execute)."""
 
-    __slots__ = ("phase", "overlay", "reads", "writes", "subst", "token", "pins")
+    __slots__ = ("phase", "overlay", "reads", "writes", "subst", "token", "pins", "__weakref__")
 
     def __init__(self, phase, subst=None):
         self.phase = phase

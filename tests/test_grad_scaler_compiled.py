@@ -1,0 +1,143 @@
+"""Dynamic loss scaling inside compiled steps (reference:
+python/paddle/amp/grad_scaler.py + update_loss_scaling op — SURVEY.md §2.3
+amp): found_inf is traced state, the skip is a lax.select over optimizer
+state writes, and the scale/counters update on-device.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(x, rg=False):
+    out = paddle.to_tensor(np.asarray(x, np.float32))
+    out.stop_gradient = not rg
+    return out
+
+
+class TestCompiledGradScaler:
+    def test_compiled_step_skips_injected_inf_and_resumes(self):
+        w = t([1.0], rg=True)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler(
+            init_loss_scaling=4.0, incr_every_n_steps=2, decr_every_n_nan_or_inf=1
+        )
+
+        @paddle.jit.to_static
+        def step(x):
+            loss = (w * x).sum()
+            scaler.scale(loss).backward()
+            scaler.step(opt)
+            scaler.update()
+            opt.clear_grad()
+            return loss
+
+        step(t([1.0]))  # grad=1: w 1.0 -> 0.9
+        np.testing.assert_allclose(w.numpy(), [0.9], rtol=1e-6)
+
+        step(t([np.inf]))  # inf grad: SAME compiled program must skip
+        np.testing.assert_allclose(w.numpy(), [0.9], rtol=1e-6)
+        assert float(scaler.get_loss_scaling().numpy()) == pytest.approx(2.0)
+
+        step(t([1.0]))  # resumes: w 0.9 -> 0.8
+        np.testing.assert_allclose(w.numpy(), [0.8], rtol=1e-6)
+
+    def test_compiled_scale_grows_after_good_steps(self):
+        w = t([1.0], rg=True)
+        opt = paddle.optimizer.SGD(learning_rate=0.0, parameters=[w])
+        scaler = paddle.amp.GradScaler(
+            init_loss_scaling=8.0, incr_every_n_steps=2, incr_ratio=2.0
+        )
+
+        @paddle.jit.to_static
+        def step(x):
+            loss = (w * x).sum()
+            scaler.scale(loss).backward()
+            scaler.step(opt)
+            scaler.update()
+            opt.clear_grad()
+            return loss
+
+        step(t([1.0]))
+        assert float(scaler.get_loss_scaling().numpy()) == pytest.approx(8.0)
+        step(t([1.0]))  # second good step: 8 -> 16
+        assert float(scaler.get_loss_scaling().numpy()) == pytest.approx(16.0)
+
+    def test_compiled_adam_first_step_skip_keeps_moments_at_init(self):
+        """A skipped FIRST step must leave accumulators at their init (the
+        reference's skipped steps never touch moments)."""
+        w = t([2.0], rg=True)
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+
+        @paddle.jit.to_static
+        def step(x):
+            loss = (w * x).sum()
+            scaler.scale(loss).backward()
+            scaler.step(opt)
+            scaler.update()
+            opt.clear_grad()
+            return loss
+
+        step(t([np.inf]))  # first step skipped
+        np.testing.assert_allclose(w.numpy(), [2.0])
+        accs = {n: a for (n, _), a in opt._accumulators.items()}
+        np.testing.assert_allclose(accs["moment1"].numpy(), [0.0])
+        np.testing.assert_allclose(float(accs["beta1_pow"].numpy()), 1.0)
+
+        step(t([1.0]))  # now a real Adam step happens
+        assert float(w.numpy()[0]) < 2.0
+        assert float(accs["beta1_pow"].numpy()) == pytest.approx(0.9)
+
+    def test_update_outside_compiled_fn_raises_clear_error(self):
+        """step() inside @to_static but update() outside: loud guidance, and
+        the discover/execute double-run must not poison the scaler."""
+        w = t([1.0], rg=True)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+
+        @paddle.jit.to_static
+        def step(x):
+            loss = (w * x).sum()
+            scaler.scale(loss).backward()
+            scaler.step(opt)  # no update() in the compiled fn
+            opt.clear_grad()
+            return loss
+
+        step(t([1.0]))  # must trace fine (no 'already been called' poison)
+        with pytest.raises(RuntimeError, match="inside the same compiled"):
+            scaler.update()
+        # scaler still usable eagerly afterwards
+        loss = (w * 2).sum()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        scaler.update()
+
+    def test_eager_parity_with_compiled(self):
+        """Same sequence eagerly and compiled gives the same weights/scale."""
+        def run(compiled):
+            paddle.seed(0)
+            w = t([1.0], rg=True)
+            opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+            scaler = paddle.amp.GradScaler(
+                init_loss_scaling=4.0, incr_every_n_steps=3, decr_every_n_nan_or_inf=1
+            )
+
+            def body(x):
+                loss = (w * x).sum()
+                scaler.scale(loss).backward()
+                scaler.step(opt)
+                scaler.update()
+                opt.clear_grad()
+                return loss
+
+            fn = paddle.jit.to_static(body) if compiled else body
+            for x in ([1.0], [np.inf], [2.0], [1.0]):
+                fn(t(x))
+            return float(w.numpy()[0]), float(scaler.get_loss_scaling().numpy())
+
+        ew, es = run(False)
+        cw, cs = run(True)
+        assert ew == pytest.approx(cw, rel=1e-6)
+        assert es == pytest.approx(cs, rel=1e-6)

@@ -4,9 +4,22 @@ elastic restart — SURVEY.md §2.2/§5.3).
 
 TPU-native process model: JAX is single-controller per HOST (one process
 drives all local chips), so `--nproc_per_node` defaults to 1 and the CLI's
-job is the multi-host contract: rendezvous (native TCPStore), the
-PADDLE_TRAINER_* env contract, per-rank log files, failure watch, and
-restart-on-failure within [--elastic min:max] bounds.
+job is the multi-host contract:
+
+- rendezvous: every node controller registers its endpoint in the native
+  TCPStore (csrc/tcp_store.cc) hosted by node 0; the membership for each
+  epoch is closed by the master and the full endpoint list + the
+  jax.distributed coordinator address are exported to trainers via the
+  PADDLE_* env contract;
+- failure watch: per-node child supervision with restart-in-place
+  (single node) or job-level epoch restart (multi node — a restarted
+  trainer cannot rejoin a live jax.distributed job, so every node
+  relaunches into a fresh coordination epoch);
+- elastic: controllers heartbeat monotonic counters into the store; when
+  the master sees a peer go stale it bumps the epoch and the surviving
+  nodes re-rendezvous — the job continues as long as >= min nodes
+  (--nnodes min:max) re-register.  Node 0 hosting the store is the single
+  point of failure, as in the reference's etcd-less collective mode.
 """
 
 from __future__ import annotations
@@ -42,6 +55,9 @@ def parse_args(argv=None):
     p.add_argument("--run_mode", type=str, default="collective")
     p.add_argument("--max_restart", type=int, default=3)
     p.add_argument("--host", type=str, default="")
+    p.add_argument("--hb_interval", type=float, default=2.0, help="heartbeat period (s)")
+    p.add_argument("--hb_timeout", type=float, default=10.0, help="declare a node dead after this many seconds without a heartbeat")
+    p.add_argument("--rdv_grace", type=float, default=2.0, help="extra wait for stragglers after min nodes registered")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -98,9 +114,9 @@ class Container:
 
 
 class CollectiveController:
-    """Reference: launch/controllers/collective.py watch loop + elastic
-    restart (fleet/elastic/manager.py behavior folded in: restart in place
-    up to --max_restart on child failure)."""
+    """Reference: launch/controllers/collective.py watch loop +
+    fleet/elastic/manager.py heartbeat/scale behavior (etcd replaced by the
+    native TCPStore)."""
 
     def __init__(self, args):
         self.args = args
@@ -108,62 +124,203 @@ class CollectiveController:
         if ":" in nn:
             lo, hi = nn.split(":")
             self.min_nodes, self.max_nodes = int(lo), int(hi)
-            self.elastic = True
         else:
             self.min_nodes = self.max_nodes = int(nn)
-            self.elastic = self.max_nodes > 1 and False
+        if self.max_nodes > 1 and args.nproc_per_node > 1:
+            # one single-controller JAX process per host is the TPU model;
+            # node-level endpoints cannot describe per-trainer ranks
+            raise SystemExit(
+                "--nproc_per_node > 1 is not supported with --nnodes > 1 "
+                "(one controller process drives all of a host's chips)"
+            )
+        self.node_rank = args.node_rank
         self.containers = []
+        self.store = None
+        self.epoch = 0
+        self.my_host = args.host or "127.0.0.1"
+        self._hb_seen = {}  # node_id -> (counter, local time of last change)
 
-    def build_endpoints(self, n):
-        base = []
-        for i in range(n):
-            base.append(f"127.0.0.1:{_free_port()}")
-        return base
+    # -- store / rendezvous ------------------------------------------------
+    def _connect_store(self):
+        from ...native import TCPStore
 
-    def run(self):
+        host, port = self.args.master.rsplit(":", 1)
+        port = int(port)
+        if self.node_rank == 0:
+            self.store = TCPStore(host="127.0.0.1", port=port, is_master=True)
+        else:
+            deadline = time.time() + 60
+            last = None
+            while time.time() < deadline:
+                try:
+                    self.store = TCPStore(host=host, port=port)
+                    break
+                except RuntimeError as e:
+                    last = e
+                    time.sleep(0.5)
+            if self.store is None:
+                raise RuntimeError(f"could not reach TCPStore master {host}:{port}: {last}")
+        self.coord = f"{host}:{port + 1}"  # jax.distributed coordinator
+
+    def _rendezvous(self, epoch):
+        """Register in an epoch; the master closes membership.  A node that
+        registers after the close (startup skew, rejoin) bumps to a fresh
+        epoch and retries so the whole job converges on one membership.
+        Returns (node_epoch_rank, n_nodes, endpoints-by-node)."""
+        st = self.store
+        while True:
+            my_ep = f"{self.my_host}:{_free_port()}"
+            rank = st.add(f"ep{epoch}/rank", 1) - 1
+            st.set(f"ep{epoch}/node/{rank}", my_ep)
+            st.set(f"ep{epoch}/nodeid/{rank}", str(self.node_rank))
+            st.add(f"hb/{self.node_rank}", 1)
+            if self.node_rank == 0:
+                # membership: wait for min nodes, then a grace window up to max
+                while st.add(f"ep{epoch}/rank", 0) < self.min_nodes:
+                    time.sleep(0.2)
+                deadline = time.time() + self.args.rdv_grace
+                while time.time() < deadline and st.add(f"ep{epoch}/rank", 0) < self.max_nodes:
+                    time.sleep(0.2)
+                st.set(f"ep{epoch}/world", str(st.add(f"ep{epoch}/rank", 0)))
+            world = int(st.get(f"ep{epoch}/world"))
+            if rank >= world:
+                # membership closed without us: request a new epoch
+                st.set(f"bump/{epoch + 1}", "1")
+                epoch += 1
+                continue
+            eps = [st.get(f"ep{epoch}/node/{i}").decode() for i in range(world)]
+            self._member_ids = [int(st.get(f"ep{epoch}/nodeid/{i}")) for i in range(world)]
+            self._hb_seen = {}
+            self.epoch = epoch
+            return rank, world, eps
+
+    # -- spawn -------------------------------------------------------------
+    def _spawn(self, node_erank, n_nodes, node_eps):
         args = self.args
         nproc = args.nproc_per_node
-        world = nproc  # per-host world; multi-host adds node offsets
-        endpoints = self.build_endpoints(world)
+        world = n_nodes * nproc
+        if n_nodes > 1:
+            endpoints = node_eps  # node-level endpoints from the exchange
+            extra = {
+                "PADDLE_MASTER": self.coord,
+                "MASTER_ADDR": self.coord.rsplit(":", 1)[0],
+                "PADDLE_RESTART_EPOCH": str(self.epoch),
+                "PADDLE_TRAINERS_NUM": str(world),
+            }
+        else:
+            endpoints = [f"127.0.0.1:{_free_port()}" for _ in range(world)]
+            extra = {}
+        self.containers = []
+        for lr in range(nproc):
+            grank = node_erank * nproc + lr
+            c = Container(
+                grank, world, endpoints, args.training_script,
+                args.training_script_args, args.log_dir, extra_env=extra,
+            )
+            c.start()
+            self.containers.append(c)
+
+    # -- run ---------------------------------------------------------------
+    def run(self):
+        args = self.args
+        multi = self.max_nodes > 1
+        if multi:
+            if not args.master:
+                raise SystemExit("--master host:port is required when nnodes > 1")
+            self._connect_store()
+            node_erank, n_nodes, node_eps = self._rendezvous(self.epoch)
+        else:
+            node_erank, n_nodes, node_eps = 0, 1, []
+
         restarts = 0
         while True:
-            self.containers = [
-                Container(
-                    r, world, endpoints, args.training_script,
-                    args.training_script_args, args.log_dir,
-                )
-                for r in range(nproc)
-            ]
+            self._spawn(node_erank, n_nodes, node_eps)
+            code = self.watch(multi, n_nodes)
             for c in self.containers:
-                c.start()
-            code = self.watch()
+                c.terminate()
             if code == 0:
                 return 0
+            if code == "interrupt":
+                return 130
+            if code == "abort":
+                return 1
+            if code == "epoch":
+                # peer died / membership change: everyone re-rendezvouses
+                self.epoch += 1
+                print(f"[launch] re-rendezvous epoch {self.epoch}", file=sys.stderr)
+                try:
+                    node_erank, n_nodes, node_eps = self._rendezvous(self.epoch)
+                except Exception as e:
+                    print(f"[launch] rendezvous failed: {e}", file=sys.stderr)
+                    return 1
+                continue
             restarts += 1
             if restarts > args.max_restart:
                 print(f"[launch] giving up after {restarts - 1} restarts", file=sys.stderr)
                 return code
-            print(f"[launch] child failed (exit {code}); restart {restarts}/{args.max_restart}", file=sys.stderr)
-            for c in self.containers:
-                c.terminate()
-            time.sleep(1)
+            print(
+                f"[launch] child failed (exit {code}); restart {restarts}/{args.max_restart}",
+                file=sys.stderr,
+            )
+            if multi:
+                # a restarted trainer cannot rejoin a live jax.distributed
+                # job: force a job-level epoch restart instead
+                self.store.set(f"bump/{self.epoch + 1}", "1")
+                self.epoch += 1
+                node_erank, n_nodes, node_eps = self._rendezvous(self.epoch)
+            else:
+                time.sleep(1)
 
-    def watch(self):
+    # -- watch -------------------------------------------------------------
+    def _heartbeat(self, now):
+        st = self.store
+        st.add(f"hb/{self.node_rank}", 1)
+        if self.node_rank != 0:
+            return None
+        # master: detect stale peers via monotonic counters (no clock skew)
+        for nid in self._member_ids:
+            if nid == self.node_rank:
+                continue
+            cnt = st.add(f"hb/{nid}", 0)  # counters are binary; add(0) reads
+            last = self._hb_seen.get(nid)
+            if last is None or cnt != last[0]:
+                self._hb_seen[nid] = (cnt, now)
+            elif now - last[1] > self.args.hb_timeout:
+                print(f"[launch] node {nid} heartbeat stale; evicting", file=sys.stderr)
+                if len(self._member_ids) - 1 >= self.min_nodes:
+                    st.set(f"bump/{self.epoch + 1}", "1")
+                    return "epoch"
+                print("[launch] below min nodes; aborting", file=sys.stderr)
+                return "abort"
+        return None
+
+    def watch(self, multi=False, n_nodes=1):
+        last_hb = 0.0
         try:
             while True:
                 codes = [c.poll() for c in self.containers]
                 if any(c is not None and c != 0 for c in codes):
-                    bad = next(c for c in codes if c is not None and c != 0)
-                    for c in self.containers:
-                        c.terminate()
-                    return bad
+                    return next(c for c in codes if c is not None and c != 0)
                 if all(c == 0 for c in codes):
                     return 0
-                time.sleep(0.5)
+                if multi:
+                    now = time.time()
+                    try:
+                        if now - last_hb >= self.args.hb_interval:
+                            last_hb = now
+                            verdict = self._heartbeat(now)
+                            if verdict is not None:
+                                return verdict
+                        if self.store.check(f"bump/{self.epoch + 1}"):
+                            return "epoch"
+                    except RuntimeError as e:
+                        # store connection lost (master exited): stop
+                        # supervising rather than running headless forever
+                        print(f"[launch] coordination store lost: {e}", file=sys.stderr)
+                        return "abort"
+                time.sleep(0.2)
         except KeyboardInterrupt:
-            for c in self.containers:
-                c.terminate()
-            return 130
+            return "interrupt"
 
 
 def main(argv=None):

@@ -172,7 +172,13 @@ class TCPStore:
         return buf.raw[:n]
 
     def add(self, key, delta):
-        return self._lib.pt_store_add(self._client, key.encode(), delta)
+        out = self._lib.pt_store_add(self._client, key.encode(), delta)
+        if out < 0:
+            # counters are non-negative by construction; -1 means the
+            # connection died (e.g. the master exited) — surface it instead
+            # of letting callers supervise forever against a dead store
+            raise RuntimeError(f"TCPStore add({key!r}) failed: connection lost")
+        return out
 
     def check(self, key):
         return bool(self._lib.pt_store_check(self._client, key.encode()))

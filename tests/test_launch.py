@@ -1,0 +1,171 @@
+"""Launch CLI: spawn, env contract, restart-on-failure with checkpoint
+resume, multi-node TCPStore rendezvous, and elastic node-loss shrink
+(reference: launch/controllers/collective.py + fleet/elastic/manager.py —
+SURVEY.md §2.2 "Launch CLI + elastic", §5.3).
+
+Multi-node is simulated as multiple controller processes on localhost (the
+reference's test pattern for test/collective/).  Scripts are tiny and pure
+python — no jax import — so the tests exercise the controller, not XLA.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+LAUNCH = [sys.executable, "-m", "paddle_tpu.distributed.launch"]
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env():
+    e = dict(os.environ)
+    e["PYTHONPATH"] = REPO + os.pathsep + e.get("PYTHONPATH", "")
+    # keep children light: no jax / TPU plugin initialization needed
+    e.pop("PALLAS_AXON_POOL_IPS", None)
+    return e
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_single_node_spawn_env_contract(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import os, json, sys\n"
+        "out = {k: os.environ.get(k) for k in ('PADDLE_TRAINER_ID',"
+        " 'PADDLE_TRAINERS_NUM', 'PADDLE_TRAINER_ENDPOINTS')}\n"
+        "open(os.environ['OUT_DIR'] + '/env.' + out['PADDLE_TRAINER_ID'], 'w')"
+        ".write(json.dumps(out))\n"
+    )
+    env = _env()
+    env["OUT_DIR"] = str(tmp_path)
+    r = subprocess.run(
+        LAUNCH + ["--nproc_per_node", "2", "--log_dir", str(tmp_path / "log"), str(script)],
+        env=env, cwd=REPO, timeout=120,
+    )
+    assert r.returncode == 0
+    for rank in (0, 1):
+        rec = json.loads((tmp_path / f"env.{rank}").read_text())
+        assert rec["PADDLE_TRAINER_ID"] == str(rank)
+        assert rec["PADDLE_TRAINERS_NUM"] == "2"
+        assert len(rec["PADDLE_TRAINER_ENDPOINTS"].split(",")) == 2
+    assert (tmp_path / "log" / "workerlog.1").exists()
+
+
+def test_restart_on_failure_resumes_from_checkpoint(tmp_path):
+    """Fault injection: the trainer crashes after 'checkpointing' step 2 on
+    its first life; the relaunched process must resume FROM the checkpoint
+    and finish (reference §5.3: restart + user-loop resume contract)."""
+    ckpt = tmp_path / "ckpt.json"
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import json, os, sys\n"
+        f"ck = {str(ckpt)!r}\n"
+        "state = json.load(open(ck)) if os.path.exists(ck) else {'step': 0, 'lives': 0}\n"
+        "state['lives'] += 1\n"
+        "start = state['step']\n"
+        "for step in range(start, 5):\n"
+        "    state['step'] = step + 1\n"
+        "    json.dump(state, open(ck, 'w'))\n"
+        "    if step == 1 and state['lives'] == 1:\n"
+        "        sys.exit(17)  # injected fault after checkpointing step 2\n"
+    )
+    r = subprocess.run(
+        LAUNCH + ["--log_dir", str(tmp_path / "log"), "--max_restart", "2", str(script)],
+        env=_env(), cwd=REPO, timeout=120,
+    )
+    assert r.returncode == 0
+    final = json.loads(ckpt.read_text())
+    assert final["lives"] == 2, "expected exactly one restart"
+    assert final["step"] == 5, "resumed run must continue from the checkpoint"
+
+
+def _start_node(args, env):
+    return subprocess.Popen(
+        LAUNCH + args, env=env, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def test_multinode_endpoint_exchange(tmp_path):
+    """Two node controllers rendezvous through the native TCPStore; each
+    trainer sees the full 2-node endpoint list and distinct node ranks."""
+    port = _free_port()
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import os, json\n"
+        "rec = {k: os.environ.get(k) for k in ('PADDLE_TRAINER_ID',"
+        " 'PADDLE_TRAINERS_NUM', 'PADDLE_TRAINER_ENDPOINTS', 'PADDLE_MASTER')}\n"
+        "open(os.environ['OUT_DIR'] + '/node.' + rec['PADDLE_TRAINER_ID'], 'w')"
+        ".write(json.dumps(rec))\n"
+    )
+    env = _env()
+    env["OUT_DIR"] = str(tmp_path)
+    common = [
+        "--nnodes", "2", "--master", f"127.0.0.1:{port}",
+        "--log_dir", str(tmp_path / "log"), str(script),
+    ]
+    n0 = _start_node(["--node_rank", "0"] + common, env)
+    n1 = _start_node(["--node_rank", "1"] + common, env)
+    assert n0.wait(timeout=120) == 0, n0.stdout.read()
+    assert n1.wait(timeout=120) == 0, n1.stdout.read()
+    recs = {}
+    for r in (0, 1):
+        recs[r] = json.loads((tmp_path / f"node.{r}").read_text())
+    for r, rec in recs.items():
+        assert rec["PADDLE_TRAINERS_NUM"] == "2"
+        eps = rec["PADDLE_TRAINER_ENDPOINTS"].split(",")
+        assert len(eps) == 2 and len(set(eps)) == 2
+        assert rec["PADDLE_MASTER"].endswith(str(port + 1))
+
+
+def test_elastic_node_loss_shrinks_world(tmp_path):
+    """Kill node 1's controller mid-run: the master detects the stale
+    heartbeat, bumps the epoch, and relaunches with world=1 (>= min)."""
+    port = _free_port()
+    script = tmp_path / "train.py"
+    # each life appends its world size; runs long enough to outlive the
+    # heartbeat timeout, except when world has shrunk to 1 (the resumed run)
+    script.write_text(
+        "import os, time\n"
+        "w = os.environ['PADDLE_TRAINERS_NUM']\n"
+        "open(os.environ['OUT_DIR'] + '/worlds', 'a').write(w + '\\n')\n"
+        "time.sleep(2 if w == '1' else 60)\n"
+    )
+    env = _env()
+    env["OUT_DIR"] = str(tmp_path)
+    # min 1 so the surviving node may continue alone after the loss
+    common = [
+        "--nnodes", "1:2", "--master", f"127.0.0.1:{port}",
+        "--hb_interval", "0.5", "--hb_timeout", "3", "--rdv_grace", "8",
+        "--log_dir", str(tmp_path / "log"), str(script),
+    ]
+    n0 = _start_node(["--node_rank", "0"] + common, env)
+    n1 = _start_node(["--node_rank", "1"] + common, env)
+    # wait until BOTH trainers are demonstrably running at world 2
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        f = tmp_path / "worlds"
+        if f.exists() and f.read_text().split().count("2") >= 2:
+            break
+        time.sleep(0.5)
+    else:
+        n0.kill(); n1.kill()
+        raise AssertionError("both trainers never reached world 2")
+    n1.send_signal(signal.SIGKILL)  # node loss
+    assert n0.wait(timeout=120) == 0, n0.stdout.read()
+    worlds = (tmp_path / "worlds").read_text().split()
+    assert "2" in worlds, f"first epoch should run at world 2: {worlds}"
+    assert worlds[-1] == "1", f"after node loss the job must shrink to 1: {worlds}"
+    n1.wait(timeout=10)

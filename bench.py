@@ -78,13 +78,15 @@ def bench_llama(deep=False):
 
     on_tpu = _on_tpu()
     if on_tpu and deep:
-        # deeper model under real memory pressure: ~950M params, 16 layers,
+        # deeper model under real memory pressure: ~750M params, 12 layers,
         # activation recompute on — closer to a 7B's residency profile
+        # (16 layers crashes the remote compile helper with the Pallas
+        # backward kernels inside remat; 12 compiles)
         cfg = LlamaConfig(
             vocab_size=32000,
             hidden_size=2048,
             intermediate_size=5632,
-            num_hidden_layers=16,
+            num_hidden_layers=12,
             num_attention_heads=16,
             num_key_value_heads=16,
             max_position_embeddings=2048,
@@ -138,7 +140,7 @@ def bench_llama(deep=False):
         "params": n_params,
         "proxy": "640M wide-6-layer single-chip proxy for config 4 (Llama-7B TP=8)"
         if not deep
-        else "950M 16-layer remat single-chip proxy",
+        else "750M 12-layer remat single-chip proxy",
     }
 
 

@@ -2,18 +2,39 @@
 save_state_dict / load_state_dict with per-rank shard files + metadata and
 reshard-on-load — SURVEY.md §5.4).
 
-TPU-native: orbax-backed sharded async checkpointing; on load, tensors are
-restored to the CURRENT sharding layout (reshard across changed meshes is
-handled by orbax/jax restore with the target sharding)."""
+TPU-native: orbax-backed sharded checkpointing; `async_save=True` hands the
+device-to-host copy to orbax's async machinery and returns immediately
+(call `wait_all()` or save again to join).  On load, tensors are restored
+to the CURRENT sharding layout, so a checkpoint written under one
+parallelism (e.g. TP=8) loads under another (e.g. ZeRO sharding=8) —
+strategy-change resume.
+
+Failures RAISE.  The round-2 behavior — swallowing any orbax error into a
+replicated .npz written by every host — is exactly the silent degradation
+SURVEY §5.4 warns about; it is now opt-in via
+FLAGS_checkpoint_fallback_npz for single-host debugging only.
+"""
 
 from __future__ import annotations
 
+import logging
 import os
 
 import numpy as np
 import jax
 
+from ..framework import core as _core
 from ..tensor import Tensor
+
+_core.define_flag(
+    "FLAGS_checkpoint_fallback_npz",
+    False,
+    "fall back to a replicated .npz when orbax save fails (single-host debug only)",
+)
+
+logger = logging.getLogger("paddle_tpu")
+
+_pending = []  # in-flight async saves
 
 
 def _flatten_sd(sd, prefix=""):
@@ -29,28 +50,60 @@ def _flatten_sd(sd, prefix=""):
     return flat
 
 
+def wait_all():
+    """Join every in-flight async save (also called before a new save to the
+    same tree and at interpreter exit via orbax's own machinery).  The
+    pending list is cleared FIRST so one failed background save raises once
+    here, not forever from every later checkpoint operation."""
+    global _pending
+    pending, _pending = _pending, []
+    errors = []
+    for ckptr in pending:
+        try:
+            ckptr.wait_until_finished()
+        except Exception as e:  # join the rest before surfacing
+            errors.append(e)
+    if errors:
+        raise errors[0]
+
+
 def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0, async_save=False):
     flat = _flatten_sd(state_dict)
     os.makedirs(path, exist_ok=True)
+    arrays = {
+        k: (v._raw if isinstance(v, Tensor) else np.asarray(v)) for k, v in flat.items()
+    }
+    target = os.path.join(path, "state")
     try:
         import orbax.checkpoint as ocp
 
-        arrays = {
-            k: (v._raw if isinstance(v, Tensor) else np.asarray(v)) for k, v in flat.items()
-        }
+        if async_save:
+            wait_all()  # one in-flight save per target tree
+            ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+            ckptr.save(target, arrays, force=True)
+            _pending.append(ckptr)
+            return ckptr
         ckptr = ocp.PyTreeCheckpointer()
-        ckptr.save(os.path.join(path, "state"), arrays, force=True)
-    except Exception:
-        # fallback: one npz (replicated values)
-        arrays = {
-            k: np.asarray(v._raw if isinstance(v, Tensor) else v) for k, v in flat.items()
-        }
-        np.savez(os.path.join(path, "state.npz"), **arrays)
+        ckptr.save(target, arrays, force=True)
+    except Exception as e:
+        if not _core.flag("FLAGS_checkpoint_fallback_npz"):
+            logger.error("distributed checkpoint save failed: %s", e)
+            raise
+        logger.warning(
+            "orbax save failed (%s); FLAGS_checkpoint_fallback_npz is set — "
+            "writing a REPLICATED npz (every host gathers full arrays)", e,
+        )
+        np.savez(
+            os.path.join(path, "state.npz"),
+            **{k: np.asarray(v) for k, v in arrays.items()},
+        )
+    return None
 
 
 def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0, offload=False):
     """Restores IN PLACE into the given state_dict's tensors, resharding to
-    each tensor's current layout."""
+    each tensor's current layout (works across parallelism changes)."""
+    wait_all()
     flat = _flatten_sd(state_dict)
     state_dir = os.path.join(path, "state")
     if os.path.isdir(state_dir):
@@ -67,6 +120,8 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0, of
                 )
         return state_dict
     npz = os.path.join(path, "state.npz")
+    if not os.path.exists(npz):
+        raise FileNotFoundError(f"no checkpoint found under {path!r}")
     data = np.load(npz)
     for k, t in flat.items():
         if k in data and isinstance(t, Tensor):

@@ -128,18 +128,23 @@ def ring_flash_attention(query, key, value, causal=True, axis_name="sep"):
 # ---------------------------------------------------------------------------
 
 
-def _ulysses_local(q, k, v, axis_name, causal, scale):
-    """Inside shard_map: shards [b, sq_local, h, d] with h divisible by ring."""
-    n = jax.lax.axis_size(axis_name)
+def _ulysses_a2a_pair(axis_name):
+    """(seq2head, head2seq) with EXPLICIT adjoint VJPs: the two transforms
+    are inverse permutations of each other, so each one's cotangent rule is
+    simply the other.  JAX's derived transpose of the asymmetric
+    all_to_all (split_axis != concat_axis, tiled=False) produces a
+    mismatched cotangent layout under jit+grad — bypass it."""
 
-    def seq2head(x):
+    def s2h_impl(x):
         # [b, s_loc, h, d] -> all_to_all -> [b, s_glob, h/n, d]
+        n = jax.lax.axis_size(axis_name)
         b, s, h, d = x.shape
         x = x.reshape(b, s, n, h // n, d)
         x = jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=False)
         return x.reshape(b, s * n, h // n, d)
 
-    def head2seq(x):
+    def h2s_impl(x):
+        n = jax.lax.axis_size(axis_name)
         b, s, h, d = x.shape
         x = x.reshape(b, n, s // n, h, d)
         # concat_axis=2 puts the source-device axis BEFORE h_loc
@@ -148,6 +153,24 @@ def _ulysses_local(q, k, v, axis_name, causal, scale):
         # whenever num_heads > sep degree (round-1 advisor finding)
         x = jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=False)
         return x.reshape(b, s // n, h * n, d)
+
+    @jax.custom_vjp
+    def s2h(x):
+        return s2h_impl(x)
+
+    s2h.defvjp(lambda x: (s2h_impl(x), None), lambda _, g: (h2s_impl(g),))
+
+    @jax.custom_vjp
+    def h2s(x):
+        return h2s_impl(x)
+
+    h2s.defvjp(lambda x: (h2s_impl(x), None), lambda _, g: (s2h_impl(g),))
+    return s2h, h2s
+
+
+def _ulysses_local(q, k, v, axis_name, causal, scale):
+    """Inside shard_map: shards [b, sq_local, h, d] with h divisible by ring."""
+    seq2head, head2seq = _ulysses_a2a_pair(axis_name)
 
     from ....ops.flash_attention import sdpa_array
 

@@ -52,7 +52,10 @@ def _run_refreshers():
 class _Trace:
     """State-slot interception for one traced call (phase = discover|execute)."""
 
-    __slots__ = ("phase", "overlay", "reads", "writes", "subst", "token", "pins", "__weakref__")
+    __slots__ = (
+        "phase", "overlay", "reads", "writes", "subst", "token", "pins",
+        "nan_checks", "__weakref__",
+    )
 
     def __init__(self, phase, subst=None):
         self.phase = phase
@@ -67,6 +70,10 @@ class _Trace:
         # tensor that touches a slot for the lifetime of the trace (cleared
         # once the trace finishes — see _trace()).
         self.pins = {}
+        # (op_name, all-finite scalar) pairs recorded by the dispatcher when
+        # FLAGS_check_nan_inf is on: they become extra program outputs so
+        # compiled steps get per-op nan attribution (SURVEY.md §5.2)
+        self.nan_checks = []
 
     @staticmethod
     def _slot_value(t, kind):
@@ -138,7 +145,10 @@ def _struct_signature(obj):
 
 
 class _CompiledEntry:
-    __slots__ = ("jitted", "state_in", "rw_flags", "state_out", "none_out", "out_template", "boxes")
+    __slots__ = (
+        "jitted", "state_in", "rw_flags", "state_out", "none_out",
+        "out_template", "boxes", "nan_names",
+    )
 
 
 class StaticFunction:
@@ -241,7 +251,9 @@ class StaticFunction:
             boxes["out"] = s_out
             boxes["none"] = s_none
             boxes["tpl"] = tpl
-            return out_arrays, tuple(s_vals)
+            boxes["nan_names"] = [n for n, _ in tr.nan_checks]
+            nan_flags = tuple(f for _, f in tr.nan_checks)
+            return out_arrays, tuple(s_vals), nan_flags
 
         entry = _CompiledEntry()
         entry.state_in = state_in
@@ -284,13 +296,17 @@ class StaticFunction:
                 v = t._raw if kind == "data" else t._grad_raw
                 (rw_vals if rw else ro_vals).append(v)
 
-        out_arrays, state_vals = entry.jitted(arg_arrays, ro_vals, rw_vals)
+        out_arrays, state_vals, nan_flags = entry.jitted(arg_arrays, ro_vals, rw_vals)
 
         if entry.state_out is None:
             entry.state_out = entry.boxes["out"]
             entry.none_out = entry.boxes["none"]
             entry.out_template = entry.boxes["tpl"]
+            entry.nan_names = entry.boxes["nan_names"]
 
+        # state writeback MUST precede the nan raise: rw state was donated,
+        # so the old buffers are already invalid — raising first would leave
+        # params/moments pointing at deleted arrays for a caller who catches
         for (t, kind), v in zip(entry.state_out, state_vals):
             if kind == "data":
                 t._raw = v
@@ -299,6 +315,17 @@ class StaticFunction:
         for (t, kind) in entry.none_out:
             if kind == "grad":
                 t._grad_raw = None
+
+        if nan_flags:
+            import numpy as _np
+
+            finite = _np.asarray(nan_flags)  # syncs; flag-gated debug path
+            if not finite.all():
+                bad = [n for n, ok in zip(entry.nan_names, finite) if not ok]
+                raise FloatingPointError(
+                    "NaN or Inf found in compiled step; first offending ops: "
+                    + ", ".join(bad[:5])
+                )
 
         out_tensors = []
         for a in out_arrays:

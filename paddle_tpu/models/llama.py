@@ -39,6 +39,11 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tensor_parallel_degree: int = 1
     sequence_parallel: bool = False
+    # long-context parallelism over the 'sep' mesh axis (SURVEY.md §5.7):
+    # sep_degree routes attention through Ulysses (all-to-all seq<->head),
+    # context_parallel_degree through ring attention (ppermute KV rotation).
+    sep_degree: int = 1
+    context_parallel_degree: int = 1
     use_recompute: bool = False
     tie_word_embeddings: bool = False
 
@@ -157,12 +162,48 @@ class LlamaAttention(nn.Layer):
             out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, is_causal=s > 1)
         else:
             new_cache = None
-            out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, is_causal=True)
+            out = self._dispatch_attention(q, k, v, attn_mask)
         out = out.reshape([b, s, self.num_heads * self.head_dim])
         out = self.o_proj(out)
         if new_cache is not None:
             return out, new_cache
         return out
+
+    def _dispatch_attention(self, q, k, v, attn_mask):
+        """Route by config: ring (context parallel) > Ulysses (sep) > flash.
+        Both long-context paths ride the 'sep' mesh axis and degrade to
+        plain flash attention when the mesh doesn't provide it."""
+        cfg = self.config
+        sep_n = _mesh.axis_size("sep")
+        want_ring = sep_n > 1 and cfg.context_parallel_degree > 1 and attn_mask is None
+        want_ulysses = sep_n > 1 and cfg.sep_degree > 1 and attn_mask is None
+        if want_ring or want_ulysses:
+            # ring/Ulysses operate on equal q/k head counts: expand GQA kv
+            # heads first (same repeat sdpa_array does internally)
+            if self.num_kv_heads != self.num_heads:
+                rep = self.num_heads // self.num_kv_heads
+                k = ops.repeat_interleave(k, rep, axis=2)
+                v = ops.repeat_interleave(v, rep, axis=2)
+            if want_ring:
+                from ..distributed.fleet.meta_parallel.ring_attention import (
+                    ring_flash_attention,
+                )
+
+                return ring_flash_attention(q, k, v, causal=True)
+            if self.num_heads % sep_n == 0:
+                from ..distributed.fleet.meta_parallel.ring_attention import (
+                    ulysses_attention,
+                )
+
+                return ulysses_attention(q, k, v, causal=True)
+            import warnings
+
+            warnings.warn(
+                f"sep_degree set but num_attention_heads ({self.num_heads}) is "
+                f"not divisible by the sep mesh axis ({sep_n}); falling back "
+                "to flash attention"
+            )
+        return F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, is_causal=True)
 
 
 class LlamaDecoderLayer(nn.Layer):

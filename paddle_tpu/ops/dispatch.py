@@ -72,6 +72,8 @@ def apply(fn, inputs, name=None, multi=False, outputs_stop_gradient=None):
         if outputs_stop_gradient is not None:
             for t, sg in zip(tensors, outputs_stop_gradient):
                 t.stop_gradient = sg
+        if _core.flag("FLAGS_check_nan_inf"):
+            _check_nan_inf(name or "op", tensors)
         return tensors if multi else tensors[0]
 
     diff_idx = [
@@ -114,12 +116,22 @@ def apply(fn, inputs, name=None, multi=False, outputs_stop_gradient=None):
 
 
 def _check_nan_inf(name, tensors):
-    """FLAGS_check_nan_inf (reference: nan_inf_utils_detail) — eager only."""
+    """FLAGS_check_nan_inf (reference: nan_inf_utils_detail).
+
+    Eager: check immediately and raise with op attribution.  Traced
+    (@to_static): record an all-finite reduction on the active trace; the
+    compiled program returns the flags as extra outputs and the caller
+    raises with the same attribution (SURVEY.md §5.2)."""
+    tr = _core.active_trace()
     for t in tensors:
         a = t._raw
+        if not _is_inexact(a):
+            continue
         if isinstance(a, jax.core.Tracer):
-            return
-        if _is_inexact(a) and not bool(jnp.isfinite(a).all()):
+            if tr is not None:
+                tr.nan_checks.append((name, jnp.isfinite(a).all()))
+            continue
+        if not bool(jnp.isfinite(a).all()):
             raise FloatingPointError(f"NaN or Inf found in output of op '{name}'")
 
 

@@ -5,11 +5,18 @@ library; here a Pallas TPU kernel + an XLA blockwise fallback).
 Layout convention follows the reference API: [batch, seq, num_heads, head_dim].
 
 Design (see /opt/skills/guides/pallas_guide.md):
-- forward: online-softmax blockwise kernel; grid over (batch*heads, q blocks);
-  K/V streamed through VMEM; causal masking applied per block.
-- backward: blockwise recompute (flash-attention-2 style) expressed in JAX —
-  XLA fuses it well on TPU; a hand-written Pallas backward is a later
-  optimization.
+- forward: online-softmax kernel; grid (batch*heads, q blocks, k blocks)
+  with k innermost — each step DMAs ONE [block_k, d] K/V tile through VMEM
+  and carries (m, l, acc) in VMEM scratch across the sequential grid, so
+  sequence length is bounded by HBM, not VMEM (32k+ works).
+- backward: hand-written FA-2 kernels — dkdv (grid over k blocks, q
+  streamed) and dq (grid over q blocks, k streamed) — recomputing p from
+  (q, k, lse); delta = rowsum(g*out) precomputed outside.  An XLA blockwise
+  path remains as fallback for masks/odd shapes and as the parity oracle.
+- varlen: packed sequences with SEGMENT IDS (the static-shape TPU encoding
+  of the reference's flash_attn_varlen cu_seqlens API): attention is masked
+  to seg_q == seg_k in the kernels; `flash_attn_varlen` converts cu_seqlens
+  to segment ids.
 """
 
 from __future__ import annotations
@@ -40,83 +47,338 @@ def _on_tpu():
 # ---------------------------------------------------------------------------
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale, block_q, block_k, seq_len):
+def _blk_mask(s, q_start, k_start, block_q, block_k, causal, sq=None, sk=None):
+    """Apply causal and/or segment masking to a [block_q, block_k] score
+    block.  sq/sk: per-row/col segment ids (or None)."""
+    masked = s
+    if causal:
+        q_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        masked = jnp.where(q_ids >= k_ids, masked, _NEG_INF)
+    if sq is not None:
+        masked = jnp.where(sq[:, None] == sk[None, :], masked, _NEG_INF)
+    return masked
+
+
+def _flash_fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, causal, scale, block_q, block_k, seg_refs=(),
+):
+    """Grid (bh, q blocks, k blocks), k innermost: one K/V tile per step,
+    (m, l, acc) carried in VMEM scratch across the sequential grid."""
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
-    q = q_ref[...]  # [block_q, d] — keep half precision for the MXU
-
-    m = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
-    acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
-
-    num_k_blocks = seq_len // block_k
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
     q_start = qi * block_q
+    k_start = ki * block_k
 
-    def body(ki, carry):
-        m, l, acc = carry
-        k = k_ref[pl.ds(ki * block_k, block_k), :]
-        v = v_ref[pl.ds(ki * block_k, block_k), :]
-        # bf16 operands, fp32 accumulate; scale folded into the fp32 scores
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        if causal:
-            q_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_ids = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # causal: blocks strictly above the diagonal contribute nothing
+    needed = (k_start <= q_start + block_q - 1) if causal else True
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[...]  # [block_q, d] — half precision operands for the MXU
+        k = k_ref[...]
+        v = v_ref[...]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        sq = sk = None
+        if seg_refs:
+            sq = seg_refs[0][:, 0]
+            sk = seg_refs[1][:, 0]
+        s = _blk_mask(s, q_start, k_start, block_q, block_k, causal, sq, sk)
+        m = m_scr[:, 0]
+        l = l_scr[:, 0]
         m_new = jnp.maximum(m, s.max(-1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + p.sum(-1)
-        acc_new = acc * alpha[:, None] + jnp.dot(
+        m_scr[...] = m_new[:, None]
+        l_scr[...] = (alpha * l + p.sum(-1))[:, None]
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + jnp.dot(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32
         )
-        return m_new, l_new, acc_new
 
-    upper = (q_start + block_q + block_k - 1) // block_k if causal else num_k_blocks
-    m, l, acc = jax.lax.fori_loop(0, upper, body, (m, l, acc))
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[...] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[...] = (m + jnp.log(l_safe))[:, None]
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_scr[:, 0], 1e-30)
+        o_ref[...] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[...] = (m_scr[:, 0] + jnp.log(l_safe))[:, None]
 
 
-def _pallas_flash_forward(q, k, v, causal, scale, block_q=512, block_k=512):
-    """q,k,v: [bh, seq, d] — returns (out [bh, seq, d], lse [bh, seq] f32)."""
+def _pick_block(seq_len, pref):
+    """Largest multiple-of-128 divisor of seq_len that is <= pref: big
+    blocks amortize the per-grid-step q reload (seq 384 must pick 384, not
+    128 — a 3x3 grid of tiny programs measurably regressed BERT)."""
+    best = 128
+    b = 128
+    while b <= min(seq_len, pref):
+        if seq_len % b == 0:
+            best = b
+        b += 128
+    return best
+
+
+def _pallas_flash_forward(q, k, v, causal, scale, segments=None, n_heads=1,
+                          block_q=512, block_k=512, interpret=False):
+    """q,k,v: [bh, seq, d]; segments: optional [b, seq, 1] int32 (shared
+    across the head dim via the index map).
+    Returns (out [bh, seq, d], lse [bh, seq, 1] f32)."""
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     bh, seq_len, d = q.shape
-    # block sizes must divide the sequence (the grid/fori_loop floor-divide
-    # would otherwise silently skip trailing q rows / k blocks, e.g. s=640
-    # with block 512); the caller guarantees s % 128 == 0, so 128 always works
-    block_q = next(b for b in (block_q, 256, 128) if seq_len % b == 0 and b <= seq_len)
-    block_k = next(b for b in (block_k, 256, 128) if seq_len % b == 0 and b <= seq_len)
-    grid = (bh, seq_len // block_q)
+    # block sizes must divide the sequence (the caller guarantees s % 128
+    # == 0, so 128 always works)
+    block_q = _pick_block(seq_len, block_q)
+    block_k = _pick_block(seq_len, block_k)
+    grid = (bh, seq_len // block_q, seq_len // block_k)
 
-    kernel = functools.partial(
-        _flash_fwd_kernel,
-        causal=causal,
-        scale=scale,
-        block_q=block_q,
-        block_k=block_k,
-        seq_len=seq_len,
-    )
+    in_specs = [
+        pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [q, k, v]
+    if segments is not None:
+        in_specs += [
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b // n_heads, i, 0)),
+            pl.BlockSpec((None, block_k, 1), lambda b, i, j: (b // n_heads, j, 0)),
+        ]
+        args += [segments, segments]
+
+    def kernel(q_ref, k_ref, v_ref, *rest):
+        if segments is not None:
+            seg_refs, rest = rest[:2], rest[2:]
+        else:
+            seg_refs = ()
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+        _flash_fwd_kernel(
+            q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+            causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+            seg_refs=seg_refs,
+        )
+
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, seq_len, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, seq_len, d), lambda b, i: (b, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
             # [bh, seq, 1] — a trailing unit dim keeps the block TPU-tileable
-            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
             jax.ShapeDtypeStruct((bh, seq_len, 1), jnp.float32),
         ],
-    )(q, k, v)
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+
+
+# ---------------------------------------------------------------------------
+# Pallas backward kernels (FA-2: recompute p from q,k,lse; delta precomputed)
+# ---------------------------------------------------------------------------
+
+
+def _flash_bwd_dkdv_kernel(
+    q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr, *, causal, scale, block_q, block_k, seg_refs=(),
+):
+    """Grid (bh, k blocks, q blocks), q innermost; dk/dv accumulate in
+    scratch across the q sweep."""
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+    k_start = ki * block_k
+    q_start = qi * block_q
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    needed = (q_start + block_q - 1 >= k_start) if causal else True
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        g = g_ref[...]
+        lse = lse_ref[:, 0]
+        delta = delta_ref[:, 0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        sq = sk = None
+        if seg_refs:
+            sq = seg_refs[0][:, 0]
+            sk = seg_refs[1][:, 0]
+        s = _blk_mask(s, q_start, k_start, block_q, block_k, causal, sq, sk)
+        p = jnp.exp(s - lse[:, None])  # [bq, bk] f32
+        pb = p.astype(g.dtype)
+        dv_scr[...] += jnp.dot(pb.T, g, preferred_element_type=jnp.float32)
+        dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
+        dk_scr[...] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_q - 1)
+    def _finish():
+        dk_ref[...] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    *, causal, scale, block_q, block_k, seg_refs=(),
+):
+    """Grid (bh, q blocks, k blocks), k innermost; dq accumulates in
+    scratch across the k sweep."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    needed = (k_start <= q_start + block_q - 1) if causal else True
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        g = g_ref[...]
+        lse = lse_ref[:, 0]
+        delta = delta_ref[:, 0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        sq = sk = None
+        if seg_refs:
+            sq = seg_refs[0][:, 0]
+            sk = seg_refs[1][:, 0]
+        s = _blk_mask(s, q_start, k_start, block_q, block_k, causal, sq, sk)
+        p = jnp.exp(s - lse[:, None])
+        dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
+        dq_scr[...] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        dq_ref[...] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _pallas_flash_backward(q, k, v, g, out, lse, causal, scale, segments=None,
+                           n_heads=1, block_q=512, block_k=512, interpret=False):
+    """All [bh, s, d] (lse [bh, s, 1] f32; segments [b, s, 1]).
+    Returns (dq, dk, dv)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, s, d = q.shape
+    block_q = _pick_block(s, block_q)
+    block_k = _pick_block(s, block_k)
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
+    )  # [bh, s, 1]
+
+    common = dict(causal=causal, scale=scale, block_q=block_q, block_k=block_k)
+
+    # -- dk/dv: grid over k blocks, stream q --------------------------------
+    in_specs = [
+        pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, j, 0)),  # q
+        pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, i, 0)),  # k
+        pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, i, 0)),  # v
+        pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, j, 0)),  # g
+        pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, j, 0)),  # lse
+        pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, j, 0)),  # delta
+    ]
+    args = [q, k, v, g, lse, delta]
+    if segments is not None:
+        in_specs += [
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b // n_heads, j, 0)),
+            pl.BlockSpec((None, block_k, 1), lambda b, i, j: (b // n_heads, i, 0)),
+        ]
+        args += [segments, segments]
+
+    def dkdv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest):
+        seg_refs = rest[:2] if segments is not None else ()
+        dk_ref, dv_ref, dk_scr, dv_scr = rest[-4:]
+        _flash_bwd_dkdv_kernel(
+            q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+            dk_scr, dv_scr, seg_refs=seg_refs, **common,
+        )
+
+    dk, dv = pl.pallas_call(
+        dkdv_kernel,
+        grid=(bh, s // block_k, s // block_q),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+
+    # -- dq: grid over q blocks, stream k -----------------------------------
+    in_specs = [
+        pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),  # q
+        pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),  # k
+        pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),  # v
+        pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),  # g
+        pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),  # lse
+        pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),  # delta
+    ]
+    args = [q, k, v, g, lse, delta]
+    if segments is not None:
+        in_specs += [
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b // n_heads, i, 0)),
+            pl.BlockSpec((None, block_k, 1), lambda b, i, j: (b // n_heads, j, 0)),
+        ]
+        args += [segments, segments]
+
+    def dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest):
+        seg_refs = rest[:2] if segments is not None else ()
+        dq_ref, dq_scr = rest[-2:]
+        _flash_bwd_dq_kernel(
+            q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+            seg_refs=seg_refs, **common,
+        )
+
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, s // block_q, s // block_k),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(*args)
+    return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
@@ -259,49 +521,110 @@ def _log_pallas_fallback(reason):
         _fallback_logged = True
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash_attention_core(q, k, v, mask, causal, scale):
-    out, _ = _flash_fwd_impl(q, k, v, mask, causal, scale)
+# tests set this to exercise the Pallas kernels off-TPU via interpret mode
+_FORCE_INTERPRET = False
+
+
+def _pallas_viable(q, k, mask):
+    s, d = q.shape[2], q.shape[3]
+    if mask is not None:
+        return False, "attn_mask given"
+    if s % 128 != 0 or q.shape != k.shape:
+        return False, f"seq {s} not a 128-multiple or q/k shapes differ"
+    if d > 256:
+        return False, f"head_dim {d} > 256"
+    return True, None
+
+
+def _segments_mask(segments, b, h):
+    """[b, s] segment ids -> additive [b, 1, s, s] mask for the XLA paths."""
+    eq = segments[:, None, :, None] == segments[:, None, None, :]
+    return jnp.where(eq, 0.0, _NEG_INF).astype(jnp.float32)
+
+
+def _seg_flat(segments, h):
+    """[b, s] -> [b, s, 1] int32 for the Pallas kernels (the kernels' seg
+    BlockSpecs divide the bh grid coordinate by n_heads, so no per-head
+    broadcast is materialized)."""
+    return segments[:, :, None].astype(jnp.int32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _flash_attention_core(q, k, v, mask, segments, causal, scale):
+    out, _, _ = _flash_fwd_impl(q, k, v, mask, segments, causal, scale)
     return out
 
 
-def _flash_fwd_impl(q, k, v, mask, causal, scale):
-    """q,k,v: [b, h, s, d] → (out, lse)."""
+def _flash_fwd_impl(q, k, v, mask, segments, causal, scale):
+    """q,k,v: [b, h, s, d] → (out, lse [b,h,s], used_pallas)."""
     b, h, s, d = q.shape
-    if _on_tpu():
-        if mask is not None:
-            _log_pallas_fallback("attn_mask given")
-        elif s % 128 != 0 or q.shape != k.shape:
-            _log_pallas_fallback(f"seq {s} not a 128-multiple or q/k shapes differ")
-        elif d > 256:
-            _log_pallas_fallback(f"head_dim {d} > 256")
-        else:
+    interpret = _FORCE_INTERPRET
+    if _on_tpu() or interpret:
+        ok, reason = _pallas_viable(q, k, mask)
+        if ok:
             qf = q.reshape(b * h, s, d)
             kf = k.reshape(b * h, s, d)
             vf = v.reshape(b * h, s, d)
-            out, lse = _pallas_flash_forward(qf, kf, vf, causal, scale)
-            return out.reshape(b, h, s, d), lse.reshape(b, h, s)  # lse [bh,s,1]
-    return _blockwise_attention(q, k, v, mask, causal, scale)
+            segf = _seg_flat(segments, h) if segments is not None else None
+            out, lse = _pallas_flash_forward(
+                qf, kf, vf, causal, scale, segments=segf, n_heads=h,
+                interpret=interpret,
+            )
+            return out.reshape(b, h, s, d), lse.reshape(b, h, s), True
+        _log_pallas_fallback(reason)
+    if segments is not None:
+        seg_mask = _segments_mask(segments, b, h)
+        mask = seg_mask if mask is None else mask + seg_mask
+    out, lse = _blockwise_attention(q, k, v, mask, causal, scale)
+    return out, lse, False
 
 
-def _flash_fwd_rule(q, k, v, mask, causal, scale):
-    out, lse = _flash_fwd_impl(q, k, v, mask, causal, scale)
-    return out, (q, k, v, mask, out, lse)
+def _flash_fwd_rule(q, k, v, mask, segments, causal, scale):
+    out, lse, used_pallas = _flash_fwd_impl(q, k, v, mask, segments, causal, scale)
+    return out, (q, k, v, mask, segments, out, lse, used_pallas)
 
 
 def _flash_bwd_rule(causal, scale, res, g):
-    q, k, v, mask, out, lse = res
+    q, k, v, mask, segments, out, lse, used_pallas = res
+    if used_pallas:
+        b, h, s, d = q.shape
+        segf = _seg_flat(segments, h) if segments is not None else None
+        dq, dk, dv = _pallas_flash_backward(
+            q.reshape(b * h, s, d),
+            k.reshape(b * h, s, d),
+            v.reshape(b * h, s, d),
+            g.reshape(b * h, s, d),
+            out.reshape(b * h, s, d),
+            lse.reshape(b * h, s, 1),
+            causal,
+            scale,
+            segments=segf,
+            n_heads=h,
+            interpret=_FORCE_INTERPRET,
+        )
+        return (
+            dq.reshape(q.shape),
+            dk.reshape(k.shape),
+            dv.reshape(v.shape),
+            None,
+            None,
+        )
+    if segments is not None:
+        seg_mask = _segments_mask(segments, q.shape[0], q.shape[1])
+        mask = seg_mask if mask is None else mask + seg_mask
     dq, dk, dv = _flash_backward(q, k, v, mask, out, lse, g, causal, scale)
-    return dq, dk, dv, None
+    return dq, dk, dv, None, None
 
 
 _flash_attention_core.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def sdpa_array(q, k, v, mask=None, causal=False, scale=None):
+def sdpa_array(q, k, v, mask=None, causal=False, scale=None, segment_ids=None):
     """Array-level SDPA used by models and by the Tensor-level op below.
 
     q,k,v: [batch, seq, heads, dim] → out [batch, seq, heads, dim].
+    segment_ids: optional [batch, seq] int — attention is confined to
+    positions with equal ids (packed-sequence / varlen semantics).
     """
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
@@ -314,8 +637,32 @@ def sdpa_array(q, k, v, mask=None, causal=False, scale=None):
         rep = hq // hk
         kt = jnp.repeat(kt, rep, axis=1)
         vt = jnp.repeat(vt, rep, axis=1)
-    out = _flash_attention_core(qt, kt, vt, mask, causal, scale)
+    out = _flash_attention_core(qt, kt, vt, mask, segment_ids, causal, scale)
     return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def cu_seqlens_to_segment_ids(cu_seqlens, total_len):
+    """[n+1] cumulative lengths -> [total_len] segment ids (padding tail,
+    if any, lands in the last registered segment's id + 1 region and is
+    masked against everything by construction)."""
+    pos = jnp.arange(total_len, dtype=jnp.int32)
+    return jnp.searchsorted(jnp.asarray(cu_seqlens, jnp.int32)[1:], pos, side="right")
+
+
+def flash_attn_varlen_array(q, k, v, cu_seqlens, causal=True, scale=None):
+    """Packed varlen attention (reference: phi flash_attn_varlen /
+    flash_attn_unpadded, paddle/phi/kernels/gpu/flash_attn_kernel.cu).
+
+    q,k,v: [total, heads, dim] — sequences packed along dim 0;
+    cu_seqlens: [n+1] int with cu[0]==0, cu[-1]<=total.  TPU-native: the
+    packed layout + segment-id masking keeps shapes static for XLA.
+    """
+    total = q.shape[0]
+    seg = cu_seqlens_to_segment_ids(cu_seqlens, total)[None, :]  # [1, total]
+    out = sdpa_array(
+        q[None], k[None], v[None], None, causal, scale, segment_ids=seg
+    )
+    return out[0]
 
 
 def scaled_dot_product_attention(
@@ -343,3 +690,28 @@ def scaled_dot_product_attention(
 
         out = _dropout(out, dropout_p, training=training)
     return out
+
+
+def flash_attn_varlen(query, key, value, cu_seqlens_q, cu_seqlens_k=None, causal=True, scale=None):
+    """Tensor-level varlen entry (reference: paddle flash_attn_unpadded).
+    Only self-attention layouts (shared cu_seqlens) are supported."""
+    query, key, value = coerce(query), coerce(key), coerce(value)
+    cu = coerce(cu_seqlens_q)
+    if cu_seqlens_k is not None and cu_seqlens_k is not cu_seqlens_q:
+        cu_k = coerce(cu_seqlens_k)
+        same = (
+            cu_k._raw.shape == cu._raw.shape
+            and not isinstance(cu._raw, jax.core.Tracer)
+            and not isinstance(cu_k._raw, jax.core.Tracer)
+            and bool((cu_k._raw == cu._raw).all())
+        )
+        if not same:
+            raise NotImplementedError(
+                "flash_attn_varlen: distinct cu_seqlens_k is not supported "
+                "(self-attention layouts only); pass equal cu_seqlens"
+            )
+
+    def f(q, k, v, cq):
+        return flash_attn_varlen_array(q, k, v, cq, causal, scale)
+
+    return apply(f, [query, key, value, cu], name="flash_attn_varlen")

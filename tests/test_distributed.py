@@ -271,6 +271,69 @@ class TestAutoParallelAPI:
 
 
 class TestDistributedCheckpoint:
+    def test_strategy_change_resume(self, tmp_path):
+        """Save under TP=8 (dim-1 sharding), load under ZeRO sharding=8
+        (dim-0 sharding): reshard-on-load across parallelism strategies
+        (SURVEY.md §5.4 auto-parallel converter contract)."""
+        from paddle_tpu.distributed.checkpoint import load_state_dict, save_state_dict
+
+        pmesh.build_mesh(mp=8)
+        col = fleet.ColumnParallelLinear(8, 16, has_bias=False)
+        orig = col.weight.numpy().copy()
+        save_state_dict({"w": col.weight}, str(tmp_path / "ckpt"))
+
+        pmesh.build_mesh(sharding=8)
+        w2 = t(np.zeros((8, 16)))
+        pmesh.shard_tensor_(w2, P("sharding", None))
+        load_state_dict({"w": w2}, str(tmp_path / "ckpt"))
+        np.testing.assert_allclose(w2.numpy(), orig, rtol=1e-6)
+        assert w2._raw.sharding.shard_shape(w2._raw.shape) == (1, 16)
+
+    def test_async_save_then_load(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import (
+            load_state_dict,
+            save_state_dict,
+            wait_all,
+        )
+
+        pmesh.build_mesh(sharding=8)
+        w = t(np.random.rand(16, 4))
+        pmesh.shard_tensor_(w, P("sharding", None))
+        orig = w.numpy().copy()
+        handle = save_state_dict({"w": w}, str(tmp_path / "ckpt"), async_save=True)
+        assert handle is not None
+        wait_all()
+        w._data = w._data * 0
+        load_state_dict({"w": w}, str(tmp_path / "ckpt"))
+        np.testing.assert_allclose(w.numpy(), orig, rtol=1e-6)
+
+    def test_save_failure_raises(self, tmp_path, monkeypatch):
+        """No silent npz degradation: a failing orbax save must raise
+        (unless the debug fallback flag is set)."""
+        import orbax.checkpoint as ocp
+        import pytest as _pytest
+
+        from paddle_tpu.distributed.checkpoint import save_state_dict
+
+        def boom(self, *a, **k):
+            raise RuntimeError("injected orbax failure")
+
+        monkeypatch.setattr(ocp.PyTreeCheckpointer, "save", boom)
+        sd = {"w": t(np.ones(4))}
+        with _pytest.raises(RuntimeError, match="injected"):
+            save_state_dict(sd, str(tmp_path / "ckpt"))
+        assert not (tmp_path / "ckpt" / "state.npz").exists()
+
+        # debug flag opts back into the replicated-npz fallback
+        from paddle_tpu.framework import core as _core
+
+        _core.set_flags({"FLAGS_checkpoint_fallback_npz": True})
+        try:
+            save_state_dict(sd, str(tmp_path / "ckpt"))
+            assert (tmp_path / "ckpt" / "state.npz").exists()
+        finally:
+            _core.set_flags({"FLAGS_checkpoint_fallback_npz": False})
+
     def test_save_load_reshard(self, tmp_path):
         pmesh.build_mesh(mp=8)
         col = fleet.ColumnParallelLinear(8, 16, has_bias=False)

@@ -1,0 +1,69 @@
+"""FLAGS_check_nan_inf inside compiled steps (reference:
+paddle/fluid/framework/details/nan_inf_utils — SURVEY.md §5.2): the flag
+injects per-op isfinite reductions into the traced program and the step
+raises with op attribution.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import core as _core
+
+
+@pytest.fixture
+def nan_flag():
+    _core.set_flags({"FLAGS_check_nan_inf": True})
+    yield
+    _core.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def t(x, rg=False):
+    out = paddle.to_tensor(np.asarray(x, np.float32))
+    out.stop_gradient = not rg
+    return out
+
+
+def test_compiled_step_raises_with_op_attribution(nan_flag):
+    w = t([1.0], rg=True)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+
+    @paddle.jit.to_static
+    def step(x):
+        loss = ((w * x).log()).sum()  # log(negative) -> NaN
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    with pytest.raises(FloatingPointError, match="compiled step.*log"):
+        step(t([-1.0]))
+
+
+def test_compiled_step_clean_inputs_pass(nan_flag):
+    w = t([1.0], rg=True)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+
+    @paddle.jit.to_static
+    def step(x):
+        loss = ((w * x) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    l = step(t([2.0]))
+    assert np.isfinite(float(l.numpy()))
+
+
+def test_eager_no_grad_path_checked(nan_flag):
+    x = t([0.0])
+    with paddle.no_grad():
+        with pytest.raises(FloatingPointError, match="log"):
+            _ = x.log() / 0.0 if False else (x - 1.0).log()
+
+
+def test_flag_off_no_overhead_and_no_raise():
+    x = t([-1.0])
+    out = x.log()  # NaN, silently allowed when the flag is off
+    assert np.isnan(out.numpy()).all()

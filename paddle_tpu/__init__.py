@@ -48,6 +48,13 @@ from . import inference
 from .framework.io import save, load  # noqa: F401
 from .jit import to_static  # noqa: F401
 from .hapi import Model  # noqa: F401
+from . import hapi as callbacks  # noqa: F401  (paddle.callbacks namespace)
+
+# make `from paddle_tpu.callbacks import X` importable, not just attribute
+# access (the reference ships callbacks as a real submodule)
+import sys as _sys
+
+_sys.modules[__name__ + ".callbacks"] = callbacks
 from .distributed import DataParallel  # noqa: F401
 from . import models  # noqa: F401
 

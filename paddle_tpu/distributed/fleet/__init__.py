@@ -82,55 +82,55 @@ def get_hybrid_communicate_group_():
 
 
 def distributed_model(model):
-    """Wrap for the active parallelisms (reference: fleet.distributed_model)."""
+    """Wrap for the active parallelisms, COMPOSED in the reference's order
+    (fleet.distributed_model wraps TP then DP around a PipelineParallel) —
+    returning on the first match would leave e.g. a TP+DP model without its
+    batch sharding."""
     hcg = get_hybrid_communicate_group()
     strategy = _fleet_state.get("strategy")
     if isinstance(model, PipelineLayer):
+        # PipelineParallel stays outermost: its train_batch IS the API.
+        # TP/DP inside a pipeline model are carried by the layers' own
+        # shardings + the batch constraints of the schedule.
         return PipelineParallel(model, hcg, strategy)
     if hcg.get_model_parallel_world_size() > 1:
-        return TensorParallel(model)
+        model = TensorParallel(model)
+    if hcg.get_sharding_parallel_world_size() > 1:
+        model = ShardingParallel(model)
     if hcg.get_data_parallel_world_size() > 1:
-        return DataParallel(model)
+        model = DataParallel(model)
     return model
 
 
 class _DistributedOptimizer:
-    """Optimizer wrapper; ZeRO sharding of optimizer state over the
-    'sharding' axis happens lazily at first step (reference:
-    DygraphShardingOptimizer)."""
+    """Optimizer wrapper (reference: DygraphShardingOptimizer): ZeRO
+    stage-1 state sharding delegates to distributed.sharding's single
+    policy (accumulators born sharded over the 'sharding' axis)."""
 
     def __init__(self, optimizer, strategy=None):
         self._inner = optimizer
         self._strategy = strategy
-        self._sharded = False
+        self._maybe_shard_states()
         from ...jit import register_state_refresh
 
         register_state_refresh(self, _DistributedOptimizer._refresh_sharding)
 
     def _refresh_sharding(self):
-        # runs outside any trace, before each compiled call
-        if _mesh.axis_size("sharding") > 1:
-            self._sharded = False
-            self._maybe_shard_states()
+        # runs outside any trace, before each compiled call (the mesh may
+        # have been built after this wrapper)
+        self._maybe_shard_states()
 
     def __getattr__(self, item):
         return getattr(self._inner, item)
 
     def _maybe_shard_states(self):
-        if self._sharded:
-            return
-        self._sharded = True
-        if _mesh.axis_size("sharding") <= 1:
-            return
-        from jax.sharding import PartitionSpec as P
+        if _mesh.axis_size("sharding") > 1:
+            from ..sharding import shard_optimizer_state
 
-        for key, acc in self._inner._accumulators.items():
-            if acc._raw.ndim >= 1 and acc._raw.shape and acc._raw.shape[0] % _mesh.axis_size("sharding") == 0:
-                _mesh.shard_tensor_(acc, P("sharding"))
+            shard_optimizer_state(self._inner)
 
     def step(self):
         self._inner.step()
-        self._maybe_shard_states()
 
     def clear_grad(self, *a, **k):
         self._inner.clear_grad()

@@ -79,24 +79,44 @@ def _constrain(arr, axis=_AXIS):
     return jax.lax.with_sharding_constraint(arr, NamedSharding(mesh, spec))
 
 
-class _ShardedOptimizerWrapper:
-    def __init__(self, optimizer, level, offload=False):
-        self._inner = optimizer
-        self._level = level
-        self._offload = offload
-        # shard accumulators AT CREATION: the factory runs under
-        # ensure_compile_time_eval, so the tensor is concrete even when
-        # first touched inside a @to_static trace
+def shard_optimizer_state(optimizer, offload=False):
+    """ONE ZeRO stage-1 policy, shared by group_sharded_parallel and
+    fleet.distributed_optimizer: wrap the accumulator factory so state is
+    born sharded over the 'sharding' axis (the factory runs under
+    ensure_compile_time_eval, so tensors are concrete even when first
+    touched inside a @to_static trace), and place whatever already exists.
+    Idempotent; re-placing an already-sharded array is a no-op device_put."""
+    prev_offload = getattr(optimizer, "_zero_offload", None)
+    if prev_offload is not None and prev_offload != offload:
+        raise ValueError(
+            f"optimizer already ZeRO-sharded with offload={prev_offload}; "
+            f"re-sharding with offload={offload} would leave mixed placement"
+        )
+    optimizer._zero_offload = offload
+    if not getattr(optimizer, "_zero_acc_wrapped", False):
+        optimizer._zero_acc_wrapped = True
         orig_acc = optimizer._acc
 
         def sharded_acc(name, p, init=None, __orig=orig_acc):
             fresh = (name, optimizer._key(p)) not in optimizer._accumulators
             t = __orig(name, p, init)
             if fresh:
-                _place(t, self._offload)
+                _place(t, offload)
             return t
 
         optimizer._acc = sharded_acc
+    for acc in optimizer._accumulators.values():
+        _place(acc, offload)
+    for mw in optimizer._master_weights.values():
+        _place(mw, offload)
+
+
+class _ShardedOptimizerWrapper:
+    def __init__(self, optimizer, level, offload=False):
+        self._inner = optimizer
+        self._level = level
+        self._offload = offload
+        shard_optimizer_state(optimizer, offload)
 
     def __getattr__(self, item):
         return getattr(self._inner, item)
@@ -191,10 +211,6 @@ def group_sharded_parallel(
             # params stay in device HBM (they're used every layer); GSPMD
             # all-gathers shards on use
             _place(p, offload=False)
-    for acc in optimizer._accumulators.values():
-        _place(acc, offload)
-    for mw in optimizer._master_weights.values():
-        _place(mw, offload)
 
     opt = _ShardedOptimizerWrapper(optimizer, level, offload)
     wrapped = _ShardedModelWrapper(model, level) if level != "os" else model

@@ -1,5 +1,5 @@
-"""hapi — paddle.Model high-level fit/evaluate/predict
-(reference: python/paddle/hapi/model.py)."""
+"""hapi — paddle.Model high-level fit/evaluate/predict + callbacks
+(reference: python/paddle/hapi/model.py, python/paddle/hapi/callbacks.py)."""
 
 from __future__ import annotations
 
@@ -8,6 +8,114 @@ import numpy as np
 from .. import ops
 from ..io import DataLoader
 from ..tensor import Tensor
+
+
+class Callback:
+    """Reference: paddle.callbacks.Callback — hook points into fit()."""
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = params
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+
+class ProgBarLogger(Callback):
+    """Reference: paddle.callbacks.ProgBarLogger (prints per log_freq)."""
+
+    def __init__(self, log_freq=10, verbose=2):
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose and logs and step % self.log_freq == 0:
+            items = " ".join(f"{k}: {v:.5f}" for k, v in logs.items() if isinstance(v, float))
+            print(f"step {step}: {items}")
+
+
+class ModelCheckpoint(Callback):
+    """Reference: paddle.callbacks.ModelCheckpoint — saves per save_freq."""
+
+    def __init__(self, save_freq=1, save_dir=None):
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and (epoch + 1) % self.save_freq == 0:
+            import os
+
+            os.makedirs(self.save_dir, exist_ok=True)
+            self.model.save(os.path.join(self.save_dir, str(epoch)))
+
+
+class EarlyStopping(Callback):
+    """Reference: paddle.callbacks.EarlyStopping on an eval metric."""
+
+    def __init__(self, monitor="loss", mode="min", patience=0, min_delta=0, baseline=None, save_best_model=False):
+        if save_best_model:
+            raise NotImplementedError(
+                "EarlyStopping(save_best_model=True) is not implemented; use "
+                "callbacks.ModelCheckpoint alongside EarlyStopping"
+            )
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.sign = -1 if mode == "min" else 1
+        self.baseline = None if baseline is None else self.sign * baseline
+        self.best = self.baseline
+        self.wait = 0
+        self.stop_training = False
+
+    def on_eval_end(self, logs=None):
+        if not logs or self.monitor not in logs:
+            return
+        cur = self.sign * logs[self.monitor]
+        if self.best is None or cur > self.best + self.min_delta:
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.stop_training = True
+
+
+class _CallbackList:
+    def __init__(self, callbacks, model):
+        self.cbs = list(callbacks or [])
+        for c in self.cbs:
+            c.set_model(model)
+
+    def call(self, hook, *args, **kwargs):
+        for c in self.cbs:
+            getattr(c, hook)(*args, **kwargs)
+
+    @property
+    def stop_training(self):
+        return any(getattr(c, "stop_training", False) for c in self.cbs)
 
 
 class Model:
@@ -22,21 +130,46 @@ class Model:
         self._loss = loss
         self._metrics = metrics if isinstance(metrics, (list, tuple)) else ([metrics] if metrics else [])
 
+    def _update_metrics(self, out, label):
+        vals = {}
+        for m in self._metrics:
+            r = m.compute(out, label)
+            # the base Metric.compute passes (pred, label) through as a
+            # tuple for update(pred, label)-style metrics (Precision etc.)
+            if isinstance(r, (tuple, list)):
+                m.update(*r)
+            else:
+                m.update(r)
+            acc = m.accumulate()
+            names = m.name()
+            if isinstance(acc, (tuple, list)):
+                if not isinstance(names, (tuple, list)):
+                    names = [f"{names}_top{k}" for k in getattr(m, "topk", range(1, len(acc) + 1))]
+                for n, v in zip(names, acc):
+                    vals[n] = float(v)
+            else:
+                vals[names if not isinstance(names, (tuple, list)) else names[0]] = float(acc)
+        return vals
+
     def train_batch(self, inputs, labels=None):
         self.network.train()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         out = self.network(*inputs)
-        loss = self._loss(out, labels if not isinstance(labels, (list, tuple)) else labels[0])
+        label = labels if not isinstance(labels, (list, tuple)) else labels[0]
+        loss = self._loss(out, label)
         loss.backward()
         self._optimizer.step()
         self._optimizer.clear_grad()
+        self._last_metrics = self._update_metrics(out, label)
         return [float(loss.numpy())]
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         out = self.network(*inputs)
-        loss = self._loss(out, labels if not isinstance(labels, (list, tuple)) else labels[0])
+        label = labels if not isinstance(labels, (list, tuple)) else labels[0]
+        loss = self._loss(out, label)
+        self._last_metrics = self._update_metrics(out, label)
         return [float(loss.numpy())]
 
     def predict_batch(self, inputs):
@@ -48,27 +181,50 @@ class Model:
         loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
             train_data, batch_size=batch_size, shuffle=shuffle, drop_last=drop_last, num_workers=num_workers
         )
+        cbs = list(callbacks or [])
+        if verbose and not any(isinstance(c, ProgBarLogger) for c in cbs):
+            cbs.append(ProgBarLogger(log_freq, verbose))
+        if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbs):
+            cbs.append(ModelCheckpoint(save_freq, save_dir))
+        cblist = _CallbackList(cbs, self)
+        cblist.call("on_train_begin")
         history = []
         for epoch in range(epochs):
+            cblist.call("on_epoch_begin", epoch)
+            for m in self._metrics:
+                m.reset()
             losses = []
             for step, batch in enumerate(loader):
+                cblist.call("on_train_batch_begin", step)
                 x, y = batch[0], batch[1]
                 loss = self.train_batch(x, y)[0]
                 losses.append(loss)
-                if verbose and step % log_freq == 0:
-                    print(f"epoch {epoch} step {step}: loss {loss:.5f}")
-            history.append(float(np.mean(losses)))
+                logs = {"loss": loss, **getattr(self, "_last_metrics", {})}
+                cblist.call("on_train_batch_end", step, logs)
+            epoch_logs = {"loss": float(np.mean(losses)), **getattr(self, "_last_metrics", {})}
+            history.append(epoch_logs["loss"])
+            cblist.call("on_epoch_end", epoch, epoch_logs)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_data, batch_size=batch_size, verbose=verbose)
+                cblist.call("on_eval_begin")
+                result = self.evaluate(eval_data, batch_size=batch_size, verbose=verbose)
+                cblist.call("on_eval_end", result)
+            if cblist.stop_training:
+                break
+        cblist.call("on_train_end")
         return history
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2, num_workers=0, callbacks=None):
         loader = eval_data if isinstance(eval_data, DataLoader) else DataLoader(eval_data, batch_size=batch_size)
+        cblist = _CallbackList(callbacks, self)
+        cblist.call("on_eval_begin")
+        for m in self._metrics:
+            m.reset()
         losses = []
         for batch in loader:
             x, y = batch[0], batch[1]
             losses.append(self.eval_batch(x, y)[0])
-        result = {"loss": float(np.mean(losses))}
+        result = {"loss": float(np.mean(losses)), **getattr(self, "_last_metrics", {})}
+        cblist.call("on_eval_end", result)
         if verbose:
             print(f"eval: {result}")
         return result

@@ -50,11 +50,60 @@ class ProcessMesh:
         return self._jax_mesh
 
     def __getitem__(self, idx):
-        # sub-mesh selection
-        return self
+        """Sub-mesh selection (reference: ProcessMesh.__getitem__): an int
+        fixes dim 0 (dropping it); slices/tuples numpy-index the id grid."""
+        arr = np.asarray(self._ids).reshape(self._shape)
+        sub = arr[idx]
+        if sub.ndim == 0:
+            sub = sub.reshape(1)
+            names = ["d0"]
+        else:
+            # dims that survived keep their names (int indices drop dims
+            # left-to-right, slices keep them); int-likes are coerced and
+            # fancier index forms are rejected rather than mis-named
+            idxs = idx if isinstance(idx, tuple) else (idx,)
+            names = []
+            di = 0
+            for i in idxs:
+                if isinstance(i, slice):
+                    names.append(self._dim_names[di])
+                    di += 1
+                    continue
+                try:
+                    import operator
+
+                    operator.index(i)
+                except TypeError:
+                    raise TypeError(
+                        f"ProcessMesh indices must be ints or slices, got {i!r}"
+                    ) from None
+                di += 1
+            names += self._dim_names[di:]
+        return ProcessMesh(sub.tolist(), dim_names=names)
 
     def get_mesh_with_dim(self, name):
-        return self
+        """Mesh re-ordered with dim `name` first (reference semantics)."""
+        if name not in self._dim_names:
+            raise ValueError(f"unknown mesh dim {name!r}; have {self._dim_names}")
+        order = [self._dim_names.index(name)] + [
+            i for i, n in enumerate(self._dim_names) if n != name
+        ]
+        arr = np.asarray(self._ids).reshape(self._shape).transpose(order)
+        return ProcessMesh(arr.tolist(), dim_names=[self._dim_names[i] for i in order])
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ProcessMesh)
+            and self._shape == other._shape
+            and self._ids == other._ids
+            and self._dim_names == other._dim_names
+        )
+
+    def __hash__(self):
+        return hash((tuple(self._shape), tuple(self._ids), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dim_names={self._dim_names})"
 
 
 class Shard:
@@ -82,15 +131,33 @@ def _placements_to_spec(placements, ndim, mesh):
     for axis_idx, placement in enumerate(placements):
         if isinstance(placement, Shard):
             entries[placement.dim] = mesh.dim_names[axis_idx]
+        elif isinstance(placement, Partial):
+            # In the multi-process reference a Partial dist tensor's global
+            # value is the SUM of per-rank locals.  A single-controller
+            # concrete array already holds the total, so accepting Partial
+            # here would silently change the value's meaning.
+            raise NotImplementedError(
+                "Partial placement has no single-controller encoding for "
+                "concrete tensors: the array you pass already holds the "
+                "total value.  Partial-sum intermediates (sharded matmul "
+                "contractions) are handled inside compiled programs by "
+                "GSPMD; to express an eager sum over per-rank blocks, use "
+                "paddle.distributed.all_reduce on an axis-sharded tensor."
+            )
     return P(*entries)
 
 
 def shard_tensor(x, mesh, placements=None, dist_attr=None, stop_gradient=None):
-    """Attach a distributed layout (reference: dygraph shard_tensor API)."""
+    """Attach a distributed layout (reference: dygraph shard_tensor API).
+    Concrete tensors are device_put; traced values get a
+    with_sharding_constraint so the layout lands inside compiled programs
+    too (GSPMD inserts the collectives)."""
     t = x if isinstance(x, Tensor) else Tensor(x)
     spec = _placements_to_spec(placements or [], t.ndim, mesh)
     sh = NamedSharding(mesh.jax_mesh, spec)
-    if not isinstance(t._raw, jax.core.Tracer):
+    if isinstance(t._raw, jax.core.Tracer):
+        t._data = jax.lax.with_sharding_constraint(t._data, sh)
+    else:
         t._raw = jax.device_put(t._raw, sh)
     t.placements = placements
     t.process_mesh = mesh
@@ -103,6 +170,10 @@ def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
 
 
 def reshard(x, mesh, placements):
+    """Convert a dist tensor to a new layout.  The reference implements
+    this as a pass inserting collectives; here jax.device_put IS the
+    reshard — XLA emits the all-gather/all-to-all/slice needed to move
+    between the layouts (including across different meshes)."""
     return shard_tensor(x, mesh, placements)
 
 

@@ -109,15 +109,37 @@ def device_guard(device=None):
     return _guard()
 
 
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, program=None):
-    raise NotImplementedError(
-        "use paddle_tpu.inference.export(model, path, example_inputs) — "
-        "serializes a StableHLO program via jax.export"
-    )
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, program=None, layer=None):
+    """Export an inference artifact (reference: paddle.static.save_inference_model).
+
+    The static-graph ProgramDesc does not exist here — the program IS a
+    traced StableHLO module — so the exportable unit is a Layer (pass it as
+    `program=` or `layer=`) traced at the feed_vars' shapes/dtypes; the
+    serialized module + weights land at <path_prefix>.stablehlo /
+    .pdiparams (paddle_tpu.inference.export does the work).  fetch_vars is
+    accepted for API parity; the exported outputs are the layer's outputs.
+    """
+    target = layer if layer is not None else program
+    if target is None or not (hasattr(target, "eval") and hasattr(target, "state_dict")):
+        raise TypeError(
+            "save_inference_model needs the model Layer (pass program=<Layer>); "
+            "a static ProgramDesc does not exist in this framework — the "
+            "traced StableHLO module is the program"
+        )
+    from ..inference import export as _export
+
+    feed = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    return _export(target, path_prefix, feed)
 
 
 def load_inference_model(path_prefix, executor):
-    raise NotImplementedError("use paddle_tpu.inference.Predictor(path)")
+    """Returns (predictor, feed_names, fetch_names) — the predictor plays
+    the reference's (program, feed_target_names, fetch_targets) role; run
+    via predictor.run([arrays...])."""
+    from ..inference import Predictor
+
+    p = Predictor(path_prefix)
+    return p, p.get_input_names(), p.get_output_names()
 
 
 def set_program_state(program, state):

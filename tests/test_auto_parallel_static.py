@@ -1,0 +1,65 @@
+"""Auto-parallel API completeness + static inference export (reference:
+python/paddle/distributed/auto_parallel/process_mesh.py sub-mesh selection;
+python/paddle/static save/load_inference_model — SURVEY.md §2.2/§2.3).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn, static
+
+
+class TestProcessMesh:
+    def test_getitem_submesh(self):
+        mesh = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["x", "y"])
+        sub = mesh[0]
+        assert sub.shape == [4]
+        assert sub.process_ids == [0, 1, 2, 3]
+        assert sub.dim_names == ["y"]
+        sub2 = mesh[:, 1]
+        assert sub2.shape == [2]
+        assert sub2.process_ids == [1, 5]
+        assert sub2.dim_names == ["x"]
+
+    def test_get_mesh_with_dim(self):
+        mesh = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["x", "y"])
+        ymesh = mesh.get_mesh_with_dim("y")
+        assert ymesh.dim_names == ["y", "x"]
+        assert ymesh.shape == [4, 2]
+        assert ymesh.process_ids == [0, 4, 1, 5, 2, 6, 3, 7]
+
+    def test_partial_placement_raises(self):
+        mesh = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["x", "y"])
+        w = paddle.to_tensor(np.random.rand(8, 4).astype(np.float32))
+        with pytest.raises(NotImplementedError, match="Partial"):
+            dist.shard_tensor(w, mesh, [dist.Partial(), dist.Replicate()])
+
+    def test_reshard_moves_layout(self):
+        mesh = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["x", "y"])
+        w = paddle.to_tensor(np.random.rand(8, 4).astype(np.float32))
+        w = dist.shard_tensor(w, mesh, [dist.Shard(0), dist.Replicate()])
+        assert w._raw.sharding.shard_shape(w._raw.shape) == (4, 4)
+        w = dist.reshard(w, mesh, [dist.Replicate(), dist.Shard(1)])
+        assert w._raw.sharding.shard_shape(w._raw.shape) == (8, 1)
+
+
+class TestStaticInference:
+    def test_save_load_inference_model(self, tmp_path):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        net.eval()
+        x = paddle.to_tensor(np.random.RandomState(0).rand(2, 4).astype(np.float32))
+        ref = net(x).numpy()
+
+        prefix = str(tmp_path / "model")
+        static.save_inference_model(prefix, [x], None, None, program=net)
+        predictor, feed_names, fetch_names = static.load_inference_model(prefix, None)
+        assert feed_names and fetch_names
+        out = predictor.run([x])
+        np.testing.assert_allclose(out[0], ref, rtol=1e-6)
+
+    def test_save_without_layer_raises(self, tmp_path):
+        with pytest.raises(TypeError, match="Layer"):
+            static.save_inference_model(str(tmp_path / "m"), [], None, None)

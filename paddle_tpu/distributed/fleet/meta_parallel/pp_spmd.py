@@ -54,14 +54,39 @@ def stage_scan(block_fn, local_params, h, remat=True):
     return h
 
 
+def virtual_layer_order(n_layers, pp, num_virtual):
+    """Physical storage order for interleaved virtual stages: position
+    (s, vi, j) holds LOGICAL layer (vi*pp + s)*l + j, so a plain contiguous
+    P('pp') dim-0 sharding gives stage s exactly its `num_virtual` chunks
+    (Megatron placement: chunk c runs on stage c % pp).  Returns the
+    logical-layer index for each physical slot; identity when v == 1."""
+    l = n_layers // (pp * num_virtual)
+    order = []
+    for s in range(pp):
+        for vi in range(num_virtual):
+            for j in range(l):
+                order.append((vi * pp + s) * l + j)
+    return order
+
+
 def pipeline_apply(block_fn, stacked_params, x, n_micro, axis_name=_AXIS,
-                   mesh=None, remat=True):
+                   mesh=None, remat=True, num_virtual=1):
     """Run `x` through all stacked layers with pp-pipelined execution.
 
     block_fn(layer_params, h) -> h applies ONE layer (leaf shapes without
     the leading layer dim).  `stacked_params` is a pytree whose leaves
-    have leading dim = total layer count, sharded P('pp') on dim 0.
+    have leading dim = total layer count, sharded P('pp') on dim 0 — for
+    num_virtual > 1 the layers must be STORED in virtual_layer_order().
     x: [B, S, H] hidden states with B % n_micro == 0.  Returns [B, S, H].
+
+    num_virtual > 1 accepts Megatron-interleaved WEIGHT PLACEMENT (chunk c
+    on stage c % pp, stored in virtual_layer_order) and executes the chunk
+    columns as sequential pipeline passes — each column pipelines normally
+    and the activation wraps the ring back to stage 0 between columns.
+    This keeps AD memory at one activation per in-flight microbatch; the
+    true circular schedule (which also shrinks the bubble by v) needs
+    per-stage wait buffers whose scan carries multiply activation memory
+    by n_micro — rejected for now, documented honestly.
 
     pp == 1 (or no mesh) degenerates to a plain scan over layers.
     """
@@ -71,9 +96,10 @@ def pipeline_apply(block_fn, stacked_params, x, n_micro, axis_name=_AXIS,
         return stage_scan(block_fn, stacked_params, x, remat)
 
     n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
-    if n_layers % pp != 0:
+    if n_layers % (pp * num_virtual) != 0:
         raise ValueError(
-            f"pipeline needs layer count ({n_layers}) divisible by pp degree ({pp})"
+            f"pipeline needs layer count ({n_layers}) divisible by "
+            f"pp degree * num_virtual ({pp} * {num_virtual})"
         )
     b = x.shape[0]
     if b % n_micro != 0:
@@ -82,8 +108,8 @@ def pipeline_apply(block_fn, stacked_params, x, n_micro, axis_name=_AXIS,
     # microbatch-major view; pin the per-microbatch batch dim to 'dp' so every
     # tick uses the full dp width (the reshape alone would leave microbatches
     # stacked inside single dp shards)
-    xs = x.reshape((n_micro, mb) + x.shape[1:])
-    xs = _mesh.constraint(xs, P(None, "dp"))
+    xs0 = x.reshape((n_micro, mb) + x.shape[1:])
+    xs0 = _mesh.constraint(xs0, P(None, "dp"))
 
     def local_fn(params, xs):
         idx = jax.lax.axis_index(axis_name)
@@ -106,18 +132,42 @@ def pipeline_apply(block_fn, stacked_params, x, n_micro, axis_name=_AXIS,
         return hs[pp - 1 :]
 
     param_specs = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
-    fn = jax.shard_map(
-        local_fn,
-        mesh=mesh,
-        in_specs=(param_specs, P()),
-        out_specs=P(axis_name),
-        axis_names={axis_name},
-        check_vma=False,
-    )
-    stacked_out = fn(stacked_params, xs)  # [pp * n_micro, mb, S, H]
-    out = stacked_out.reshape((pp, n_micro, mb) + x.shape[1:])[-1]
-    out = _mesh.constraint(out, P(None, "dp"))
-    return out.reshape(x.shape)
+
+    def run_column(params, xs):
+        fn = jax.shard_map(
+            local_fn,
+            mesh=mesh,
+            in_specs=(param_specs, P()),
+            out_specs=P(axis_name),
+            axis_names={axis_name},
+            check_vma=False,
+        )
+        stacked_out = fn(params, xs)  # [pp * n_micro, mb, S, H]
+        out = stacked_out.reshape((pp, n_micro, mb) + x.shape[1:])[-1]
+        return _mesh.constraint(out, P(None, "dp"))
+
+    if num_virtual == 1:
+        out = run_column(stacked_params, xs0)
+        return out.reshape(x.shape)
+
+    # interleaved storage: local leaves are [v*l, ...] in (vi, j) order; a
+    # global reshape + slice gives chunk column vi still P('pp')-sharded
+    xs = xs0
+    lpc = n_layers // (pp * num_virtual)  # layers per chunk
+    for vi in range(num_virtual):
+        col = jax.tree_util.tree_map(
+            lambda a: a.reshape((pp, num_virtual, lpc) + a.shape[1:])[:, vi]
+            .reshape((pp * lpc,) + a.shape[1:]),
+            stacked_params,
+        )
+        col = jax.tree_util.tree_map(
+            lambda a: jax.lax.with_sharding_constraint(
+                a, jax.sharding.NamedSharding(mesh, P(axis_name))
+            ),
+            col,
+        )
+        xs = run_column(col, xs)
+    return xs.reshape(x.shape)
 
 
 def place_stacked_param(t, extra_spec=()):

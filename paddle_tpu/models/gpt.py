@@ -26,6 +26,7 @@ from ..distributed.fleet.meta_parallel import (
 from ..distributed.fleet.meta_parallel.pp_spmd import (
     pipeline_apply,
     place_stacked_param,
+    virtual_layer_order,
 )
 from ..nn import functional as F
 from ..nn import initializer as I
@@ -254,9 +255,10 @@ class GPTStackedDecoder(nn.Layer):
     sharding and p2p is lax.ppermute over ICI.
     """
 
-    def __init__(self, config):
+    def __init__(self, config, num_virtual=1):
         super().__init__()
         self.config = config
+        self.num_virtual = num_virtual
         L, h, inter = (
             config.num_hidden_layers,
             config.hidden_size,
@@ -283,9 +285,30 @@ class GPTStackedDecoder(nn.Layer):
             place_stacked_param(getattr(self, name), _STACKED_EXTRA_SPECS.get(name, ()))
 
     def forward(self, x, n_micro=1, remat=True):
+        loaded_pp = getattr(self, "_loaded_pp", None)
+        if self.num_virtual > 1 and loaded_pp is not None:
+            from ..distributed import mesh as _m
+
+            if _m.axis_size("pp") != loaded_pp:
+                raise RuntimeError(
+                    f"interleaved weights were loaded for pp={loaded_pp} but "
+                    f"the mesh now has pp={_m.axis_size('pp')}; the physical "
+                    "layer order is pp-dependent — reload the weights on the "
+                    "new mesh"
+                )
         params = [getattr(self, name) for name in _STACKED_FIELDS]
         fn = self._pipeline_fn(n_micro, remat)
         return _dispatch_apply(fn, [x] + params, name="gpt_pp_pipeline")
+
+    def _storage_order(self):
+        """Physical layer order: interleaved for num_virtual > 1 (chunk c on
+        stage c % pp), identity otherwise."""
+        from ..distributed import mesh as _m
+
+        pp = _m.axis_size("pp")
+        if self.num_virtual > 1 and pp > 1:
+            return virtual_layer_order(self.config.num_hidden_layers, pp, self.num_virtual)
+        return list(range(self.config.num_hidden_layers))
 
     def _pipeline_fn(self, n_micro, remat):
         """jitted pipeline entry, cached per (n_micro, remat, mesh).
@@ -306,8 +329,12 @@ class GPTStackedDecoder(nn.Layer):
                 eps=cfg.layer_norm_epsilon,
             )
 
+            nv = self.num_virtual
+
             def raw(x_arr, *leaves):
-                return pipeline_apply(block, tuple(leaves), x_arr, n_micro, remat=remat)
+                return pipeline_apply(
+                    block, tuple(leaves), x_arr, n_micro, remat=remat, num_virtual=nv
+                )
 
             fn = jax.jit(raw)
             cache[key] = fn
@@ -315,9 +342,19 @@ class GPTStackedDecoder(nn.Layer):
 
     def load_from_layers(self, layers):
         """Stack per-layer weights from a list of GPTDecoderLayer (parity
-        harness: the dense model and the pipelined model share weights)."""
+        harness: the dense model and the pipelined model share weights).
+        Layers land in this decoder's physical storage order (interleaved
+        when num_virtual > 1)."""
+        order = self._storage_order()
+        # pin the layout: the storage order depends on the pp degree at load
+        # time, and forward re-derives it from the live mesh — a mesh change
+        # in between would silently run layers out of order
+        from ..distributed import mesh as _m
+
+        self._loaded_pp = _m.axis_size("pp")
+
         def stack(get):
-            return np.stack([np.asarray(get(l)._raw) for l in layers])
+            return np.stack([np.asarray(get(layers[i])._raw) for i in order])
 
         self.ln1_w._data = jnp.asarray(stack(lambda l: l.ln_1.weight))
         self.ln1_b._data = jnp.asarray(stack(lambda l: l.ln_1.bias))
@@ -345,7 +382,7 @@ class GPTForCausalLMSpmdPipe(nn.Layer):
     pipeline-parallel training step (and compiles under @to_static).
     """
 
-    def __init__(self, config, num_micro_batches=1):
+    def __init__(self, config, num_micro_batches=1, num_virtual_pipeline_stages=1):
         super().__init__()
         if config.hidden_dropout_prob or config.attention_probs_dropout_prob:
             raise NotImplementedError(
@@ -356,7 +393,7 @@ class GPTForCausalLMSpmdPipe(nn.Layer):
         self.config = config
         self.num_micro_batches = num_micro_batches
         self.embeddings = GPTEmbeddings(config)
-        self.blocks = GPTStackedDecoder(config)
+        self.blocks = GPTStackedDecoder(config, num_virtual=num_virtual_pipeline_stages)
         self.ln_f = nn.LayerNorm(config.hidden_size, config.layer_norm_epsilon)
         if _use_tp(config):
             self.lm_head = ColumnParallelLinear(

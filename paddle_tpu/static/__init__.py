@@ -129,6 +129,11 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, program=N
     from ..inference import export as _export
 
     feed = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    if not feed or any(f is None for f in feed):
+        raise TypeError(
+            "save_inference_model needs non-empty feed_vars (example input "
+            "tensors defining the traced shapes/dtypes)"
+        )
     return _export(target, path_prefix, feed)
 
 

@@ -16,6 +16,33 @@ from .framework.core import (  # noqa: F401
 )
 
 
+def set_memory_fraction(fraction, device=None):
+    """Cap the HBM fraction the process preallocates (reference:
+    FLAGS_fraction_of_gpu_memory_to_use over the BFC allocator).
+
+    TPU-native: the allocator is PJRT's; the knob is
+    XLA_PYTHON_CLIENT_MEM_FRACTION and it only takes effect BEFORE the
+    first jax backend initialization — call this first thing, or set the
+    env var in the launcher.  Raises if the backend is already live with a
+    different setting rather than silently doing nothing."""
+    import os
+
+    import jax
+
+    want = float(fraction)
+    live = getattr(getattr(jax._src, "xla_bridge", None), "_backends", None)
+    cur = os.environ.get("XLA_PYTHON_CLIENT_MEM_FRACTION")
+    already = cur is not None and float(cur) == want
+    if live and not already:
+        raise RuntimeError(
+            "set_memory_fraction must run before the first jax computation "
+            "(the PJRT allocator is configured at backend init); set "
+            f"XLA_PYTHON_CLIENT_MEM_FRACTION={want} in the environment or "
+            "call earlier"
+        )
+    os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] = str(want)
+
+
 def get_all_device_type():
     kinds = {"cpu"}
     try:

@@ -110,3 +110,68 @@ class Predictor:
 
 def create_predictor(config):
     return Predictor(config)
+
+
+def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True):
+    """Minimal serving loop over a compiled program (reference capability:
+    the AnalysisPredictor behind paddle_serving — SURVEY.md §2.1 "Inference
+    runtime").  POST /predict with a JSON body
+    {"inputs": [nested lists, ...]} returns {"outputs": [...]}; GET /health
+    returns 200.  Stdlib-only; one XLA executable, requests run serially
+    (XLA itself parallelizes across the chip).
+    """
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    predictor = (
+        path_or_predictor
+        if isinstance(path_or_predictor, Predictor)
+        else Predictor(path_or_predictor)
+    )
+    lock = threading.Lock()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _reply(self, code, payload):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/health":
+                self._reply(200, {"status": "ok"})
+            else:
+                self._reply(404, {"error": "use POST /predict"})
+
+        def do_POST(self):
+            if self.path != "/predict":
+                self._reply(404, {"error": "use POST /predict"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n))
+                avals = predictor._exported.in_avals
+                # cast to each traced input's dtype (ids models take ints)
+                arrays = [
+                    np.asarray(x, avals[i].dtype if i < len(avals) else np.float32)
+                    for i, x in enumerate(req["inputs"])
+                ]
+                with lock:  # one executable; serialize callers
+                    outs = predictor.run(arrays)
+                self._reply(200, {"outputs": [o.tolist() for o in outs]})
+            except Exception as e:
+                self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    if block:
+        server.serve_forever()
+        return server
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import itertools
 import math
+import pickle
 import queue
 import threading
 
@@ -237,22 +238,38 @@ class DistributedBatchSampler(BatchSampler):
 # ---------------------------------------------------------------------------
 
 
-def default_collate_fn(batch):
+def _np_collate(batch):
+    """default_collate_fn shape, but numpy-only: safe inside forked workers
+    (touching jax after fork risks wedging the inherited XLA runtime)."""
     sample = batch[0]
     if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch))
+        return np.stack(batch)
     if isinstance(sample, Tensor):
-        return Tensor(np.stack([s.numpy() for s in batch]))
+        return np.stack([s.numpy() for s in batch])
     if isinstance(sample, (int, np.integer)):
-        return Tensor(np.asarray(batch, np.int64))
+        return np.asarray(batch, np.int64)
     if isinstance(sample, (float, np.floating)):
-        return Tensor(np.asarray(batch, np.float32))
+        return np.asarray(batch, np.float32)
     if isinstance(sample, (list, tuple)):
         transposed = list(zip(*batch))
-        return [default_collate_fn(list(items)) for items in transposed]
+        return [_np_collate(list(items)) for items in transposed]
     if isinstance(sample, dict):
-        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+        return {k: _np_collate([d[k] for d in batch]) for k in sample}
     return batch
+
+
+def _tensorize(obj):
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, list):
+        return [_tensorize(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _tensorize(v) for k, v in obj.items()}
+    return obj
+
+
+def default_collate_fn(batch):
+    return _tensorize(_np_collate(batch))
 
 
 class DataLoader:
@@ -277,8 +294,12 @@ class DataLoader:
     ):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
+        self._custom_collate = collate_fn is not None
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -315,7 +336,16 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._iter_batches()
             return
-        # background-thread prefetch pipeline
+        if self.use_shared_memory and not self._iterable_mode:
+            try:
+                yield from self._iter_multiprocess()
+                return
+            except _MPUnavailable:
+                pass  # e.g. non-picklable dataset: thread prefetch below
+        yield from self._iter_threaded()
+
+    def _iter_threaded(self):
+        # background-thread prefetch pipeline (GIL-bound but zero-copy)
         q = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
         sentinel = object()
 
@@ -334,6 +364,112 @@ class DataLoader:
                 break
             yield item
 
+    def _iter_multiprocess(self):
+        """Multiprocess workers (reference: paddle.io.DataLoader
+        num_workers>0 — _DataLoaderIterMultiProcess): each worker process
+        collates whole index-batches; results return via pickle over a
+        multiprocessing queue, ordered by batch index.  Falls back to the
+        thread path when the dataset/collate can't cross a fork."""
+        import multiprocessing as mp
+
+        try:
+            ctx = mp.get_context("fork")
+        except ValueError as e:
+            raise _MPUnavailable(str(e))
+
+        batches = list(self.batch_sampler)
+        nw = min(self.num_workers, max(len(batches), 1))
+        task_q = ctx.Queue()
+        out_q = ctx.Queue(maxsize=nw * self.prefetch_factor)
+
+        # workers collate to NUMPY (never jax: touching the inherited XLA
+        # runtime in a fork child can wedge it); the parent tensorizes.
+        # A custom collate_fn runs in the worker as given — its output must
+        # be picklable and should be numpy/python.
+        collate = self.collate_fn if self._custom_collate else _np_collate
+
+        def worker(wid):
+            global _worker_info
+            _worker_info = WorkerInfo(wid, nw, self.dataset)
+            if self.worker_init_fn is not None:
+                self.worker_init_fn(wid)
+            while True:
+                item = task_q.get()
+                if item is None:
+                    return
+                bi, idxs = item
+                try:
+                    # pickle EXPLICITLY: mp.Queue serializes in a feeder
+                    # thread, where a PicklingError would vanish into the
+                    # child's stderr and hang the parent
+                    blob = pickle.dumps(collate([self.dataset[i] for i in idxs]))
+                    out_q.put((bi, blob, None))
+                except Exception as e:  # surface in parent with batch index
+                    out_q.put((bi, None, f"{type(e).__name__}: {e}"))
+
+        procs = [ctx.Process(target=worker, args=(w,), daemon=True) for w in range(nw)]
+        try:
+            for p in procs:
+                p.start()
+        except Exception as e:
+            raise _MPUnavailable(str(e))
+        try:
+            for bi, idxs in enumerate(batches):
+                task_q.put((bi, list(idxs)))
+            for _ in range(nw):
+                task_q.put(None)
+            # reorder: workers complete out of order, iteration must not
+            pending = {}
+            want = 0
+            got = 0
+            # paddle semantics: timeout=0 waits forever; a positive timeout
+            # bounds the wait (useful because fork children of a
+            # jax-threaded parent can, rarely, inherit a held lock and
+            # wedge — set a timeout to get an actionable error)
+            timeout = self.timeout if self.timeout else None
+            while got < len(batches):
+                try:
+                    bi, blob, err = out_q.get(timeout=timeout)
+                except queue.Empty:
+                    raise RuntimeError(
+                        f"DataLoader worker produced nothing for {timeout}s — "
+                        "a fork()ed worker may have deadlocked on a lock "
+                        "inherited from the jax-threaded parent; retry, or "
+                        "use use_shared_memory=False for thread-based workers"
+                    ) from None
+                got += 1
+                batch = None if blob is None else pickle.loads(blob)
+                if err is not None:
+                    raise RuntimeError(f"DataLoader worker failed on batch {bi}: {err}")
+                pending[bi] = batch
+                while want in pending:
+                    b = pending.pop(want)
+                    yield b if self._custom_collate else _tensorize(b)
+                    want += 1
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(timeout=5)
+
+
+class _MPUnavailable(RuntimeError):
+    pass
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info = None  # set inside forked DataLoader workers
+
 
 def get_worker_info():
-    return None
+    """Inside a DataLoader worker process: (id, num_workers, dataset) for
+    per-worker sharding (reference: paddle.io.get_worker_info); None in the
+    main process."""
+    return _worker_info

@@ -126,7 +126,7 @@ def _pick_block(seq_len, pref):
 
 
 def _pallas_flash_forward(q, k, v, causal, scale, segments=None, n_heads=1,
-                          block_q=512, block_k=512, interpret=False):
+                          block_q=1024, block_k=1024, interpret=False):
     """q,k,v: [bh, seq, d]; segments: optional [b, seq, 1] int32 (shared
     across the head dim via the index map).
     Returns (out [bh, seq, d], lse [bh, seq, 1] f32)."""
@@ -285,7 +285,7 @@ def _flash_bwd_dq_kernel(
 
 
 def _pallas_flash_backward(q, k, v, g, out, lse, causal, scale, segments=None,
-                           n_heads=1, block_q=512, block_k=512, interpret=False):
+                           n_heads=1, block_q=1024, block_k=1024, interpret=False):
     """All [bh, s, d] (lse [bh, s, 1] f32; segments [b, s, 1]).
     Returns (dq, dk, dv)."""
     from jax.experimental import pallas as pl

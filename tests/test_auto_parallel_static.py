@@ -63,3 +63,37 @@ class TestStaticInference:
     def test_save_without_layer_raises(self, tmp_path):
         with pytest.raises(TypeError, match="Layer"):
             static.save_inference_model(str(tmp_path / "m"), [], None, None)
+
+
+class TestServing:
+    def test_serve_predict_roundtrip(self, tmp_path):
+        import json
+        import urllib.request
+
+        import paddle_tpu.inference as inference
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        net.eval()
+        x = paddle.to_tensor(np.random.RandomState(0).rand(2, 4).astype(np.float32))
+        ref = net(x).numpy()
+        prefix = str(tmp_path / "m")
+        inference.export(net, prefix, [x])
+
+        import socket
+
+        s = socket.socket(); s.bind(("", 0)); port = s.getsockname()[1]; s.close()
+        server = inference.serve(prefix, port=port, block=False)
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict",
+                data=json.dumps({"inputs": [x.numpy().tolist()]}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                out = json.loads(r.read())
+            np.testing.assert_allclose(np.asarray(out["outputs"][0]), ref, rtol=1e-5)
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/health", timeout=10) as r:
+                assert json.loads(r.read())["status"] == "ok"
+        finally:
+            server.shutdown()

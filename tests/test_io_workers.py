@@ -1,0 +1,47 @@
+"""Multiprocess DataLoader workers (reference: paddle.io.DataLoader
+num_workers>0 — _DataLoaderIterMultiProcess, SURVEY.md §2.3 paddle.io)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset
+
+
+class _DS(Dataset):
+    def __init__(self, n=40):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.full((3,), i, np.float32), np.int64(i)
+
+
+def test_multiprocess_workers_order_and_values():
+    loader = DataLoader(_DS(), batch_size=4, num_workers=2, shuffle=False)
+    seen = []
+    for xb, yb in loader:
+        assert xb.shape == [4, 3]
+        seen.extend(yb.numpy().tolist())
+    assert seen == list(range(40)), "batches must come back in order"
+
+
+def test_multiprocess_worker_error_surfaces():
+    class Bad(_DS):
+        def __getitem__(self, i):
+            if i == 7:
+                raise ValueError("poison sample")
+            return super().__getitem__(i)
+
+    import pytest
+
+    loader = DataLoader(Bad(), batch_size=4, num_workers=2)
+    with pytest.raises(RuntimeError, match="worker failed"):
+        list(loader)
+
+
+def test_thread_fallback_still_works():
+    loader = DataLoader(_DS(8), batch_size=4, num_workers=2, use_shared_memory=False)
+    out = [y.numpy().tolist() for _, y in loader]
+    assert out == [[0, 1, 2, 3], [4, 5, 6, 7]]

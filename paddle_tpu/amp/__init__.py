@@ -57,9 +57,9 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weigh
         return (models, optimizers) if optimizers is not None else models
     target = _core.to_jax_dtype(dtype)
 
-    from ..nn.norm import _BatchNormBase, GroupNorm, LayerNorm, RMSNorm
+    from ..nn.norm import _BatchNormBase, GroupNorm, LayerNorm, RMSNorm, SpectralNorm
 
-    keep_fp32 = (_BatchNormBase, GroupNorm, LayerNorm, RMSNorm)
+    keep_fp32 = (_BatchNormBase, GroupNorm, LayerNorm, RMSNorm, SpectralNorm)
 
     for model in model_list:
         for layer in model.sublayers(include_self=True):

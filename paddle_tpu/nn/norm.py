@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..framework import core as _core
@@ -180,6 +181,63 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
+    """Spectral normalization (reference: python/paddle/nn/layer/norm.py
+    SpectralNorm over the spectral_norm op): weight / sigma_max(weight),
+    sigma estimated by power iteration on persisted u/v buffers."""
+
     def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12, name=None):
         super().__init__()
-        raise NotImplementedError("SpectralNorm lands with the GAN toolkit")
+        import jax
+
+        from ..framework.random import default_generator
+
+        self.dim = dim
+        self.power_iters = power_iters
+        self.epsilon = epsilon
+        h = int(weight_shape[dim])
+        w = int(np.prod(weight_shape)) // h
+        ku, kv = jax.random.split(default_generator.next_key())
+        u = jax.random.normal(ku, (h,), jnp.float32)
+        v = jax.random.normal(kv, (w,), jnp.float32)
+        self.weight_u = self.create_parameter([h], default_initializer=I.Assign(u / (jnp.linalg.norm(u) + epsilon)))
+        self.weight_u.stop_gradient = True
+        self.weight_v = self.create_parameter([w], default_initializer=I.Assign(v / (jnp.linalg.norm(v) + epsilon)))
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        import jax
+
+        from ..ops.dispatch import apply, coerce
+
+        weight = coerce(weight)
+        dim, iters, eps = self.dim, self.power_iters, self.epsilon
+
+        def f(w_arr, u, v):
+            mat = jnp.moveaxis(w_arr, dim, 0).reshape(w_arr.shape[dim], -1).astype(jnp.float32)
+            # the reference's spectral_norm_grad treats u/v as CONSTANTS:
+            # iterate on a stop_gradient view so the backward is d(W/sigma)
+            # with fixed singular vectors, not a power_iters-deep chain
+            mat_ng = jax.lax.stop_gradient(mat)
+            for _ in range(iters):
+                v = mat_ng.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = mat_ng @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            u = jax.lax.stop_gradient(u)
+            v = jax.lax.stop_gradient(v)
+            sigma = u @ mat @ v
+            return (w_arr / sigma.astype(w_arr.dtype)), u, v
+
+        out, u_new, v_new = apply(
+            f,
+            [weight, self.weight_u, self.weight_v],
+            multi=True,
+            name="spectral_norm",
+            outputs_stop_gradient=[weight.stop_gradient, True, True],
+        )
+        if self.training:
+            # like BN running stats, u/v only advance in train mode (eval
+            # must be deterministic and must not dirty the state_dict)
+            self.weight_u._data = u_new._data
+            self.weight_v._data = v_new._data
+        return out

@@ -116,3 +116,15 @@ def test_fleet_sharded_optimizer_single_policy():
     assert accs
     shard = accs[0]._raw.sharding.shard_shape(accs[0]._raw.shape)
     assert shard[0] == accs[0]._raw.shape[0] // 8
+
+
+def test_spectral_norm_unit_sigma():
+    paddle.seed(0)
+    sn = nn.SpectralNorm([8, 6], dim=0, power_iters=20)
+    w = paddle.to_tensor(np.random.RandomState(0).rand(8, 6).astype(np.float32) * 3)
+    out = sn(w)
+    sigma = np.linalg.svd(out.numpy(), compute_uv=False)[0]
+    assert abs(sigma - 1.0) < 1e-3
+    w.stop_gradient = False
+    (sn(w) ** 2).sum().backward()
+    assert np.isfinite(w.grad.numpy()).all()

@@ -143,6 +143,31 @@ class TestPipelineSpmd:
         l1 = float(pipe.train_batch((ids, lbl), opt).numpy())
         assert np.isfinite(l1) and l1 < l0
 
+    def test_fp16_scaler_composes_with_pipeline(self):
+        """GradScaler (compiled, on-device skip) x SPMD pipeline x AMP O2:
+        the three round-3 features in one train step."""
+        pmesh.build_mesh(pp=2)
+        cfg = _tiny()
+        paddle.seed(3)
+        model = GPTForCausalLMSpmdPipe(cfg, num_micro_batches=2)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+        model, opt = paddle.amp.decorate(model, opt, level="O2", dtype="float16")
+        scaler = paddle.amp.GradScaler(init_loss_scaling=256.0)
+        ids, lbl = _batch(cfg)
+
+        @paddle.jit.to_static
+        def step(x, y):
+            loss, _ = model(x, y)
+            scaler.scale(loss).backward()
+            scaler.step(opt)
+            scaler.update()
+            opt.clear_grad()
+            return loss
+
+        losses = [float(step(ids, lbl).numpy()) for _ in range(3)]
+        assert all(np.isfinite(l) for l in losses), losses
+        assert losses[-1] < losses[0], losses
+
     def test_train_batch_api(self):
         pmesh.build_mesh(pp=2)
         cfg = _tiny()

@@ -20,6 +20,7 @@ from ...framework.random import default_generator
 from ...tensor import Tensor
 from ...ops.dispatch import apply, coerce, amp_cast_inputs
 from ...ops import matmul as _matmul
+from ...ops.manipulation import label_smooth  # noqa: F401  (F.label_smooth)
 
 # ---------------------------------------------------------------------------
 # activations
@@ -384,6 +385,28 @@ def conv2d_transpose(
     x, weight, bias=None, stride=1, padding=0, output_padding=0,
     groups=1, dilation=1, data_format="NCHW", output_size=None, name=None,
 ):
+    if output_size is not None:
+        # resolve the stride>1 output-length ambiguity the way the
+        # reference does: derive the implied output_padding
+        strides2 = _tuplize(stride, 2)
+        dil2 = _tuplize(dilation, 2)
+        pad2 = _conv_padding(padding, 2, strides2, None, dil2)
+        if isinstance(pad2, str):
+            raise NotImplementedError(
+                "conv2d_transpose output_size with string padding is unsupported"
+            )
+        osz = _tuplize(output_size, 2)
+        kh, kw = int(weight.shape[2]), int(weight.shape[3])
+        opad = []
+        for i, (k, insz) in enumerate(zip((kh, kw), (int(x.shape[2]), int(x.shape[3])))):
+            base = (insz - 1) * strides2[i] - pad2[i][0] - pad2[i][1] + dil2[i] * (k - 1) + 1
+            extra = int(osz[i]) - base
+            if not 0 <= extra < strides2[i] + max(dil2[i], 1):
+                raise ValueError(
+                    f"requested output_size[{i}]={osz[i]} unreachable (base {base}, stride {strides2[i]})"
+                )
+            opad.append(extra)
+        output_padding = tuple(opad)
     x, weight = coerce(x), coerce(weight)
     ins = [x, weight]
     if bias is not None:
@@ -1262,3 +1285,135 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
 
 def linear_fp8(*a, **k):
     raise NotImplementedError("fp8 path lands with quantization support")
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    """x if x > threshold else value (reference: F.thresholded_relu)."""
+    x = coerce(x)
+    return apply(
+        lambda a: jnp.where(a > threshold, a, jnp.asarray(value, a.dtype)),
+        [x],
+        name="thresholded_relu",
+    )
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    """[..., L] mask with mask[..., j] = j < lengths[...] (reference:
+    paddle.nn.functional.sequence_mask).  maxlen must be static (XLA
+    shapes); defaults to int(max(lengths)) computed eagerly."""
+    lengths = coerce(lengths)
+    if maxlen is None:
+        if isinstance(lengths._data, jax.core.Tracer):
+            raise ValueError(
+                "sequence_mask needs an explicit maxlen inside traced code "
+                "(output shape must be static for XLA)"
+            )
+        maxlen = int(jnp.max(lengths._raw))
+    jd = _core.to_jax_dtype(dtype)
+
+    def f(l):
+        pos = jnp.arange(maxlen)
+        return (pos[None, :] < l.reshape(-1, 1)).reshape(l.shape + (maxlen,)).astype(jd)
+
+    return apply(f, [lengths], name="sequence_mask")
+
+
+def conv1d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0,
+    groups=1, dilation=1, data_format="NCL", output_size=None, name=None,
+):
+    """1-D transpose conv via the 2-D kernel on a unit spatial dim
+    (reference: F.conv1d_transpose)."""
+    from ... import ops as _ops
+
+    if output_size is not None:
+        raise NotImplementedError(
+            "conv1d_transpose output_size is not supported; use "
+            "output_padding to resolve the stride ambiguity"
+        )
+    if data_format != "NCL":
+        raise NotImplementedError("conv1d_transpose supports NCL layout only")
+    x = coerce(x)
+    weight = coerce(weight)
+    x4 = _ops.unsqueeze(x, 2)  # [N, C, 1, L]
+    w4 = _ops.unsqueeze(weight, 2)  # [in, out/g, 1, K]
+    out = conv2d_transpose(
+        x4, w4, bias=bias,
+        stride=(1, stride) if isinstance(stride, int) else (1, *stride),
+        padding=(0, padding) if isinstance(padding, int) else (0, *padding),
+        output_padding=(0, output_padding) if isinstance(output_padding, int) else (0, *output_padding),
+        groups=groups,
+        dilation=(1, dilation) if isinstance(dilation, int) else (1, *dilation),
+    )
+    return _ops.squeeze(out, 2)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """[N, 2, 3] affine matrices -> [N, H, W, 2] sampling grid in [-1, 1]
+    coords (reference: F.affine_grid)."""
+    theta = coerce(theta)
+    n, c, h, w = [int(s) for s in out_shape]
+
+    def f(th):
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1.0
+            xs = (jnp.arange(w) * 2 + 1) / w - 1.0
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+        return jnp.einsum("hwk,njk->nhwj", base.astype(th.dtype), th)
+
+    return apply(f, [theta], name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True, name=None):
+    """Bilinear/nearest sampling of x [N,C,H,W] at grid [N,Ho,Wo,2] (x,y in
+    [-1,1]) — reference: F.grid_sample."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample mode must be bilinear/nearest, got {mode}")
+    if padding_mode not in ("zeros", "border"):
+        raise NotImplementedError("grid_sample padding_mode: zeros/border only")
+    x, grid = coerce(x), coerce(grid)
+
+    def f(a, g):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def fetch(ix, iy):
+            # gather with border clamp; zeros handled by validity mask
+            cx = jnp.clip(ix, 0, w - 1)
+            cy = jnp.clip(iy, 0, h - 1)
+            vals = a[jnp.arange(n)[:, None, None], :, cy, cx]  # [N,Ho,Wo,C]
+            if padding_mode == "zeros":
+                ok = (ix >= 0) & (ix <= w - 1) & (iy >= 0) & (iy <= h - 1)
+                vals = vals * ok[..., None].astype(vals.dtype)
+            return vals
+
+        if mode == "nearest":
+            # half-away-from-zero like the reference kernel's ::round (jnp
+            # rounds half to even, which picks a different pixel at exact
+            # half positions)
+            rnd = lambda t: jnp.floor(t + 0.5).astype(jnp.int32)
+            out = fetch(rnd(fx), rnd(fy))
+        else:
+            x0 = jnp.floor(fx).astype(jnp.int32)
+            y0 = jnp.floor(fy).astype(jnp.int32)
+            wx = (fx - x0)[..., None]
+            wy = (fy - y0)[..., None]
+            out = (
+                fetch(x0, y0) * (1 - wx) * (1 - wy)
+                + fetch(x0 + 1, y0) * wx * (1 - wy)
+                + fetch(x0, y0 + 1) * (1 - wx) * wy
+                + fetch(x0 + 1, y0 + 1) * wx * wy
+            )
+        return jnp.transpose(out, (0, 3, 1, 2))  # [N,C,Ho,Wo]
+
+    return apply(f, [x, grid], name="grid_sample")

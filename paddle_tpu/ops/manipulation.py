@@ -565,6 +565,24 @@ def one_hot(x, num_classes, name=None):
     )
 
 
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    """(1-eps)*label + eps*prior (uniform 1/num_classes if no prior) —
+    reference: paddle.nn.functional.label_smooth."""
+    label = coerce(label)
+    if prior_dist is not None:
+        prior = coerce(prior_dist)
+        return apply(
+            lambda l, p: (1.0 - epsilon) * l + epsilon * p.astype(l.dtype),
+            [label, prior],
+            name="label_smooth",
+        )
+    return apply(
+        lambda l: (1.0 - epsilon) * l + epsilon / l.shape[-1],
+        [label],
+        name="label_smooth",
+    )
+
+
 def set_value_(x, value):
     """Replace payload (used by optimizers / state loading)."""
     value = coerce(value)

@@ -1,0 +1,50 @@
+"""Round-3 op-surface additions (reference: python/paddle/nn/functional/
+thresholded_relu / sequence_mask / conv1d_transpose / affine_grid /
+grid_sample; paddle label_smooth)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def test_thresholded_relu():
+    x = paddle.to_tensor(np.array([-1.0, 0.5, 2.0], np.float32))
+    np.testing.assert_allclose(F.thresholded_relu(x).numpy(), [0.0, 0.0, 2.0])
+
+
+def test_sequence_mask():
+    m = F.sequence_mask(paddle.to_tensor(np.array([1, 3], np.int64)), maxlen=4)
+    assert m.numpy().tolist() == [[1, 0, 0, 0], [1, 1, 1, 0]]
+    # default maxlen from data
+    m2 = F.sequence_mask(paddle.to_tensor(np.array([2, 1], np.int64)))
+    assert m2.shape == [2, 2]
+
+
+def test_conv1d_transpose_shape_and_grad():
+    x = paddle.to_tensor(np.random.RandomState(0).rand(2, 3, 8).astype(np.float32))
+    w = paddle.to_tensor(np.random.RandomState(1).rand(3, 4, 3).astype(np.float32))
+    w.stop_gradient = False
+    out = F.conv1d_transpose(x, w, stride=2)
+    assert out.shape == [2, 4, 17]
+    out.sum().backward()
+    assert np.isfinite(w.grad.numpy()).all()
+
+
+def test_grid_sample_identity_and_shift():
+    img = paddle.to_tensor(np.random.RandomState(2).rand(2, 3, 5, 7).astype(np.float32))
+    theta = paddle.to_tensor(np.tile(np.array([[1, 0, 0], [0, 1, 0]], np.float32), (2, 1, 1)))
+    grid = F.affine_grid(theta, [2, 3, 5, 7])
+    out = F.grid_sample(img, grid)
+    np.testing.assert_allclose(out.numpy(), img.numpy(), atol=1e-5)
+    # nearest mode, zeros padding beyond the border
+    g2 = paddle.to_tensor(np.full((2, 1, 1, 2), 5.0, np.float32))  # far outside
+    out2 = F.grid_sample(img, g2, mode="nearest", padding_mode="zeros")
+    np.testing.assert_allclose(out2.numpy(), np.zeros((2, 3, 1, 1)), atol=0)
+
+
+def test_label_smooth():
+    oh = paddle.one_hot(paddle.to_tensor(np.array([0, 2], np.int64)), 4)
+    out = paddle.label_smooth(oh, epsilon=0.2)
+    np.testing.assert_allclose(out.numpy()[0], [0.85, 0.05, 0.05, 0.05], rtol=1e-6)
+    assert hasattr(F, "label_smooth")

@@ -401,9 +401,10 @@ def conv2d_transpose(
         for i, (k, insz) in enumerate(zip((kh, kw), (int(x.shape[2]), int(x.shape[3])))):
             base = (insz - 1) * strides2[i] - pad2[i][0] - pad2[i][1] + dil2[i] * (k - 1) + 1
             extra = int(osz[i]) - base
-            if not 0 <= extra < strides2[i] + max(dil2[i], 1):
+            if not 0 <= extra < strides2[i]:
                 raise ValueError(
-                    f"requested output_size[{i}]={osz[i]} unreachable (base {base}, stride {strides2[i]})"
+                    f"requested output_size[{i}]={osz[i]} unreachable "
+                    f"(valid range [{base}, {base + strides2[i]}))"
                 )
             opad.append(extra)
         output_padding = tuple(opad)
@@ -439,7 +440,7 @@ def conv2d_transpose(
                 outs.append(
                     lax.conv_general_dilated(
                         a[:, g * icg : (g + 1) * icg],
-                        w2[:, g * icg - g * icg : icg] if False else jnp.transpose(jnp.flip(w[g * icg : (g + 1) * icg], (2, 3)), (1, 0, 2, 3)),
+                        jnp.transpose(jnp.flip(w[g * icg : (g + 1) * icg], (2, 3)), (1, 0, 2, 3)),
                         window_strides=(1, 1),
                         padding=padding_pairs,
                         lhs_dilation=strides,
@@ -1335,15 +1336,35 @@ def conv1d_transpose(
         raise NotImplementedError("conv1d_transpose supports NCL layout only")
     x = coerce(x)
     weight = coerce(weight)
+    def lift(v, kind):
+        """1-D arg -> 2-D with a unit leading spatial dim (stride/dilation
+        lead with 1, paddings with 0)."""
+        lead = {"stride": 1, "dil": 1, "pad": 0, "opad": 0}[kind]
+        if isinstance(v, str):
+            # lax.conv_general_dilated rejects string padding for transposed
+            # convs; surface that up-front instead of deep in lax
+            raise NotImplementedError(
+                "conv1d_transpose does not support string padding; pass "
+                "explicit int/[lo, hi] padding"
+            )
+        if isinstance(v, (list, tuple)):
+            if len(v) == 1:
+                return (lead, int(v[0]))
+            if kind == "pad" and len(v) == 2:
+                # asymmetric [lo, hi] on L -> [[0, 0], [lo, hi]]
+                return [[0, 0], [int(v[0]), int(v[1])]]
+            raise ValueError(f"conv1d_transpose {kind}={v!r} not understood")
+        return (lead, int(v))
+
     x4 = _ops.unsqueeze(x, 2)  # [N, C, 1, L]
     w4 = _ops.unsqueeze(weight, 2)  # [in, out/g, 1, K]
     out = conv2d_transpose(
         x4, w4, bias=bias,
-        stride=(1, stride) if isinstance(stride, int) else (1, *stride),
-        padding=(0, padding) if isinstance(padding, int) else (0, *padding),
-        output_padding=(0, output_padding) if isinstance(output_padding, int) else (0, *output_padding),
+        stride=lift(stride, "stride"),
+        padding=lift(padding, "pad"),
+        output_padding=lift(output_padding, "opad"),
         groups=groups,
-        dilation=(1, dilation) if isinstance(dilation, int) else (1, *dilation),
+        dilation=lift(dilation, "dil"),
     )
     return _ops.squeeze(out, 2)
 

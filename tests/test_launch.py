@@ -169,3 +169,41 @@ def test_elastic_node_loss_shrinks_world(tmp_path):
     assert "2" in worlds, f"first epoch should run at world 2: {worlds}"
     assert worlds[-1] == "1", f"after node loss the job must shrink to 1: {worlds}"
     n1.wait(timeout=10)
+
+
+def test_two_process_jax_distributed_bootstrap(tmp_path):
+    """THE multi-host contract end to end: two node controllers rendezvous
+    via TCPStore, trainers bootstrap jax.distributed from the PADDLE_*
+    env, and each process sees the 2-process global device world."""
+    port = _free_port()
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import os, sys\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "os.environ.pop('PALLAS_AXON_POOL_IPS', None)\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from paddle_tpu.distributed.env import init_parallel_env\n"
+        "env = init_parallel_env()\n"
+        "import jax\n"
+        "assert jax.process_count() == 2, jax.process_count()\n"
+        "open(os.environ['OUT_DIR'] + f'/ok.{env.rank}', 'w').write(str(len(jax.devices())))\n"
+    )
+    env = _env()
+    env["OUT_DIR"] = str(tmp_path)
+    env["JAX_PLATFORMS"] = "cpu"
+    # conftest's 8-device sim flag would inflate the per-process device
+    # count; this test wants plain 1-device-per-process semantics
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    )
+    common = [
+        "--nnodes", "2", "--master", f"127.0.0.1:{port}",
+        "--log_dir", str(tmp_path / "log"), str(script),
+    ]
+    n0 = _start_node(["--node_rank", "0"] + common, env)
+    n1 = _start_node(["--node_rank", "1"] + common, env)
+    assert n0.wait(timeout=180) == 0, n0.stdout.read()
+    assert n1.wait(timeout=180) == 0, n1.stdout.read()
+    assert (tmp_path / "ok.0").exists() and (tmp_path / "ok.1").exists()
+    assert (tmp_path / "ok.0").read_text() == "2"  # global device count

@@ -53,9 +53,27 @@ class DataParallel(_Wrapper):
         nd = len(t.shape)
         spec = P("dp", *([None] * (nd - 1)))
         sh = _mesh.sharding_for(spec)
-        if sh is not None and not isinstance(t._raw, jax.core.Tracer):
-            t = Tensor(jax.device_put(t._raw, sh), stop_gradient=t.stop_gradient)
-        return t
+        raw = t._raw
+        if sh is None or isinstance(raw, jax.core.Tracer):
+            return t
+        if isinstance(raw, jax.Array) and (
+            not raw.is_fully_addressable or raw.sharding == sh
+        ):
+            # already a global (or correctly sharded) array — e.g. the
+            # output of a previous wrapped forward; re-assembling it would
+            # crash or double-concatenate the batch
+            return t
+        if jax.process_count() > 1:
+            # multi-host: each process feeds its LOCAL batch (the reference's
+            # per-rank DataLoader contract); assemble the global dp-sharded
+            # array from the per-process shards — batch dim grows to
+            # local * num_processes.  Inputs are host-resident by contract
+            # (DataLoader numpy); a stray device array pays one host hop.
+            import numpy as np
+
+            arr = jax.make_array_from_process_local_data(sh, np.asarray(raw))
+            return Tensor(arr, stop_gradient=t.stop_gradient)
+        return Tensor(jax.device_put(raw, sh), stop_gradient=t.stop_gradient)
 
     def forward(self, *args, **kwargs):
         args = tuple(self._shard_input(a) for a in args)

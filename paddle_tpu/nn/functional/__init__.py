@@ -428,14 +428,12 @@ def conv2d_transpose(
                 (dil[i] * (k - 1) - pad[i][0], dil[i] * (k - 1) - pad[i][1] + opad[i])
                 for i, k in enumerate((kh, kw))
             ]
-        w2 = jnp.flip(w, (2, 3))  # IOHW → rotate
-        w2 = jnp.transpose(w2, (1, 0, 2, 3))  # → [out_c/g, in_c, kh, kw]
         if groups > 1:
             # split input channels into groups for grouped transpose conv
+            # (each group's kernel is flipped/transposed in the loop)
             ic = a.shape[1]
             outs = []
             icg = ic // groups
-            ocg = w2.shape[0]
             for g in range(groups):
                 outs.append(
                     lax.conv_general_dilated(
@@ -450,6 +448,8 @@ def conv2d_transpose(
                 )
             out = jnp.concatenate(outs, axis=1)
         else:
+            # IOHW → rotate 180° → [out_c, in_c, kh, kw]
+            w2 = jnp.transpose(jnp.flip(w, (2, 3)), (1, 0, 2, 3))
             out = lax.conv_general_dilated(
                 a, w2, window_strides=(1, 1), padding=padding_pairs,
                 lhs_dilation=strides, rhs_dilation=dil,
@@ -1350,9 +1350,14 @@ def conv1d_transpose(
         if isinstance(v, (list, tuple)):
             if len(v) == 1:
                 return (lead, int(v[0]))
-            if kind == "pad" and len(v) == 2:
-                # asymmetric [lo, hi] on L -> [[0, 0], [lo, hi]]
-                return [[0, 0], [int(v[0]), int(v[1])]]
+            if kind == "pad":
+                if all(isinstance(e, (list, tuple)) and len(e) == 2 for e in v):
+                    # reference pair forms: [[lo,hi]] or [[0,0],[0,0],[lo,hi]]
+                    lo, hi = v[-1]
+                    return [[0, 0], [int(lo), int(hi)]]
+                if len(v) == 2:
+                    # asymmetric [lo, hi] on L -> [[0, 0], [lo, hi]]
+                    return [[0, 0], [int(v[0]), int(v[1])]]
             raise ValueError(f"conv1d_transpose {kind}={v!r} not understood")
         return (lead, int(v))
 

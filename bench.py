@@ -155,11 +155,15 @@ def bench_resnet50():
     from paddle_tpu.vision.models import resnet50
 
     on_tpu = _on_tpu()
-    batch, steps = (128, 10) if on_tpu else (4, 2)
+    batch, steps = (256, 60) if on_tpu else (4, 2)
     size = 224 if on_tpu else 32
+    # NHWC is the TPU-native layout (channels on the minor/lane axis) —
+    # paddle's data_format="NHWC" option, same numerics as NCHW (tested in
+    # tests/test_models.py); batch 256 is the single-chip HBM sweet spot
+    fmt = "NHWC" if on_tpu else "NCHW"
 
     paddle.seed(0)
-    model = resnet50(num_classes=1000)
+    model = resnet50(num_classes=1000, data_format=fmt)
     opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9, parameters=model.parameters())
     if on_tpu:
         model, opt = paddle.amp.decorate(model, opt, level="O2", dtype="bfloat16")
@@ -174,7 +178,8 @@ def bench_resnet50():
         return loss
 
     rng = np.random.RandomState(0)
-    x = paddle.to_tensor(rng.rand(batch, 3, size, size).astype(np.float32))
+    shape = (batch, 3, size, size) if fmt == "NCHW" else (batch, size, size, 3)
+    x = paddle.to_tensor(rng.rand(*shape).astype(np.float32))
     y = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype(np.int64))
     dt = _time_steps(train_step, (x, y), steps)
     img_s = batch * steps / dt

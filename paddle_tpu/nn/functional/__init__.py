@@ -313,18 +313,23 @@ def conv2d(
     pad = _conv_padding(padding, 2, strides, None, dil)
     dn = ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "HWIO", "NHWC")
 
+    s2d = _space_to_depth_plan(x.shape, weight.shape, strides, pad, dil, groups, data_format)
+
     def f(a, w, *b):
-        if data_format == "NHWC":
-            w = jnp.transpose(w, (2, 3, 1, 0))
-        out = lax.conv_general_dilated(
-            a,
-            w,
-            window_strides=strides,
-            padding=pad,
-            rhs_dilation=dil,
-            dimension_numbers=dn,
-            feature_group_count=groups,
-        )
+        if s2d is not None:
+            out = _space_to_depth_conv(a, w, s2d, data_format)
+        else:
+            if data_format == "NHWC":
+                w = jnp.transpose(w, (2, 3, 1, 0))
+            out = lax.conv_general_dilated(
+                a,
+                w,
+                window_strides=strides,
+                padding=pad,
+                rhs_dilation=dil,
+                dimension_numbers=dn,
+                feature_group_count=groups,
+            )
         if b:
             bias_arr = b[0]
             shape = [1, -1, 1, 1] if data_format == "NCHW" else [1, 1, 1, -1]
@@ -332,6 +337,75 @@ def conv2d(
         return out
 
     return apply(f, ins, name="conv2d")
+
+
+def _space_to_depth_plan(xshape, wshape, strides, pad, dil, groups, data_format):
+    """Decide whether a low-channel strided conv (a ResNet-style stem) should
+    be rewritten as space-to-depth + dense conv.
+
+    A 7x7/s2 conv on C=3 uses 3/128 of the MXU's lanes; regrouping sxs input
+    pixels into channels turns it into an equivalent (k/s)x(k/s)/s1 conv on
+    s*s*C channels, which tiles the MXU far better.  Returns a plan dict or
+    None.  (TPU-native move; the reference's cuDNN picks specialized stem
+    kernels instead — paddle/phi/kernels/gpu conv via cudnnFind.)
+    """
+    if groups != 1 or dil != (1, 1) or isinstance(pad, str):
+        return None
+    sh, sw = strides
+    if sh != sw or sh < 2:
+        return None
+    cin = wshape[1]
+    kh, kw = wshape[2], wshape[3]
+    if cin * sh * sw > 32 or max(kh, kw) <= sh:
+        return None
+    hdim, wdim = (2, 3) if data_format == "NCHW" else (1, 2)
+    H, W = xshape[hdim], xshape[wdim]
+    k2h = -(-kh // sh) * sh  # kernel padded up to a stride multiple
+    k2w = -(-kw // sw) * sw
+    plan = {"s": sh, "k2": (k2h, k2w), "cin": cin, "cout": wshape[0], "k": (kh, kw)}
+    for dim_len, (pl, pr), k, k2, key in (
+        (H, pad[0], kh, k2h, "ph"),
+        (W, pad[1], kw, k2w, "pw"),
+    ):
+        n_win = (dim_len + pl + pr - k) // sh + 1
+        found = None
+        for extra in range(0, 2 * sh):
+            L = dim_len + pl + pr + extra
+            if L % sh == 0 and (L - k2) // sh + 1 == n_win:
+                found = (pl, pr + extra)
+                break
+        if found is None:
+            return None
+        plan[key] = found
+    return plan
+
+
+def _space_to_depth_conv(a, w, plan, data_format):
+    """Equivalent conv after space-to-depth regrouping (see plan above)."""
+    s = plan["s"]
+    kh, kw = plan["k"]
+    k2h, k2w = plan["k2"]
+    cin, cout = plan["cin"], plan["cout"]
+    (plh, prh), (plw, prw) = plan["ph"], plan["pw"]
+    if data_format == "NCHW":
+        a = jnp.transpose(a, (0, 2, 3, 1))  # stem only: one-off relayout
+    n, _, _, _ = a.shape
+    a = jnp.pad(a, ((0, 0), (plh, prh), (plw, prw), (0, 0)))
+    H2, W2 = a.shape[1] // s, a.shape[2] // s
+    # [N, H2, s, W2, s, C] -> [N, H2, W2, s*s*C]  (dh, dw, c) channel order
+    a = a.reshape(n, H2, s, W2, s, cin).transpose(0, 1, 3, 2, 4, 5).reshape(n, H2, W2, s * s * cin)
+    # weight OIHW -> padded HWIO -> regrouped [k2h/s, k2w/s, s*s*C, O]
+    w = jnp.transpose(w, (2, 3, 1, 0))  # HWIO
+    w = jnp.pad(w, ((0, k2h - kh), (0, k2w - kw), (0, 0), (0, 0)))
+    w = w.reshape(k2h // s, s, k2w // s, s, cin, cout)
+    w = w.transpose(0, 2, 1, 3, 4, 5).reshape(k2h // s, k2w // s, s * s * cin, cout)
+    out = lax.conv_general_dilated(
+        a, w, window_strides=(1, 1), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    if data_format == "NCHW":
+        out = jnp.transpose(out, (0, 3, 1, 2))
+    return out
 
 
 def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
@@ -472,14 +546,17 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_m
     k = _tuplize(kernel_size, 2)
     s = _tuplize(stride if stride is not None else kernel_size, 2)
     pad = _conv_padding(padding, 2, s, k, (1, 1))
+    nhwc = data_format == "NHWC"
     if isinstance(pad, str):
         pad_spec = pad
+    elif nhwc:
+        pad_spec = [(0, 0)] + list(pad) + [(0, 0)]
     else:
         pad_spec = [(0, 0), (0, 0)] + list(pad)
 
     def f(a):
-        dims = (1, 1) + k
-        strides = (1, 1) + s
+        dims = (1,) + k + (1,) if nhwc else (1, 1) + k
+        strides = (1,) + s + (1,) if nhwc else (1, 1) + s
         p = pad_spec if isinstance(pad_spec, str) else pad_spec
         init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
         return lax.reduce_window(a, init, lax.max, dims, strides, p)
@@ -496,11 +573,17 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusiv
     k = _tuplize(kernel_size, 2)
     s = _tuplize(stride if stride is not None else kernel_size, 2)
     pad = _conv_padding(padding, 2, s, k, (1, 1))
-    pad_spec = pad if isinstance(pad, str) else [(0, 0), (0, 0)] + list(pad)
+    nhwc = data_format == "NHWC"
+    if isinstance(pad, str):
+        pad_spec = pad
+    elif nhwc:
+        pad_spec = [(0, 0)] + list(pad) + [(0, 0)]
+    else:
+        pad_spec = [(0, 0), (0, 0)] + list(pad)
 
     def f(a):
-        dims = (1, 1) + k
-        strides = (1, 1) + s
+        dims = (1,) + k + (1,) if nhwc else (1, 1) + k
+        strides = (1,) + s + (1,) if nhwc else (1, 1) + s
         summed = lax.reduce_window(a, 0.0, lax.add, dims, strides, pad_spec)
         if divisor_override:
             return summed / divisor_override
@@ -543,17 +626,28 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
     x = coerce(x)
     out_hw = _tuplize(output_size, 2)
+    # one implementation parameterized over the spatial axes
+    h_ax, w_ax = (2, 3) if data_format == "NCHW" else (1, 2)
 
     def f(a):
-        n, c, h, w = a.shape
+        h, w = a.shape[h_ax], a.shape[w_ax]
         oh, ow = out_hw
         if h % oh == 0 and w % ow == 0:
-            return a.reshape(n, c, oh, h // oh, ow, w // ow).mean((3, 5))
+            ns = list(a.shape)
+            ns[h_ax : h_ax + 1] = [oh, h // oh]
+            ns[w_ax + 1 : w_ax + 2] = [ow, w // ow]
+            return a.reshape(ns).mean((h_ax + 1, w_ax + 2))
+
+        def _sl(axis, lo, hi):
+            idx = [slice(None)] * a.ndim
+            idx[axis] = slice(lo, hi)
+            return tuple(idx)
+
         # general: mean over variable windows
-        rows = [a[:, :, (i * h) // oh : max((i * h) // oh + 1, ((i + 1) * h + oh - 1) // oh), :].mean(2, keepdims=True) for i in range(oh)]
-        a2 = jnp.concatenate(rows, 2)
-        cols = [a2[:, :, :, (j * w) // ow : max((j * w) // ow + 1, ((j + 1) * w + ow - 1) // ow)].mean(3, keepdims=True) for j in range(ow)]
-        return jnp.concatenate(cols, 3)
+        rows = [a[_sl(h_ax, (i * h) // oh, max((i * h) // oh + 1, ((i + 1) * h + oh - 1) // oh))].mean(h_ax, keepdims=True) for i in range(oh)]
+        a2 = jnp.concatenate(rows, h_ax)
+        cols = [a2[_sl(w_ax, (j * w) // ow, max((j * w) // ow + 1, ((j + 1) * w + ow - 1) // ow))].mean(w_ax, keepdims=True) for j in range(ow)]
+        return jnp.concatenate(cols, w_ax)
 
     return apply(f, [x], name="adaptive_avg_pool2d")
 
@@ -664,7 +758,10 @@ def batch_norm(
     name=None,
 ):
     x = coerce(x)
-    (x,) = amp_cast_inputs([x], "black")
+    # The activation stays in its AMP dtype (bf16 under O2): stats and the
+    # per-channel scale/shift are computed in fp32 *inside* the kernel so XLA
+    # fuses the casts into the elementwise op — HBM traffic stays bf16.
+    # (Black-casting x here doubled activation bytes across the whole ResNet.)
     ch_axis = 1 if data_format.startswith("NC") and x.ndim > 1 else x.ndim - 1
     reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
     shape = [1] * x.ndim
@@ -673,8 +770,33 @@ def batch_norm(
     use_batch_stats = training and not use_global_stats
 
     if use_batch_stats:
-        mean = apply(lambda a: jnp.mean(a, axis=reduce_axes), [x], name="bn_mean")
-        var = apply(lambda a: jnp.var(a, axis=reduce_axes), [x], name="bn_var")
+        stats_ins = [x]
+        has_shift = running_mean is not None
+        if has_shift:
+            stats_ins.append(coerce(running_mean))
+
+        def _stats(a, *k_in):
+            # one fused pass: shifted sum and sum-of-squares reduce together
+            # (XLA multi-output fusion).  Shifting by the running mean (an
+            # independent [C] input, so the broadcast-subtract fuses into the
+            # reduce) keeps the single-pass E[(x-k)^2] - E[x-k]^2 form from
+            # cancelling catastrophically when |mean| >> std once stats have
+            # warmed up; shift-invariance makes the x-gradient exact either
+            # way.  (A data-derived shift would be exact from step 0 but
+            # forces XLA to materialize the shifted activations — measured
+            # ~10% off ResNet50 step time.)
+            a32 = a.astype(jnp.float32)
+            k = (
+                jax.lax.stop_gradient(k_in[0].astype(jnp.float32)).reshape(shape)
+                if k_in
+                else jnp.zeros(shape, jnp.float32)
+            )
+            d = a32 - k
+            m = jnp.mean(d, axis=reduce_axes)
+            ms = jnp.mean(d * d, axis=reduce_axes)
+            return m + k.reshape(m.shape), jnp.maximum(ms - m * m, 0.0)
+
+        mean, var = apply(_stats, stats_ins, name="bn_stats", multi=True)
         # update running stats in-place (buffers)
         if running_mean is not None:
             from ... import ops as _ops
@@ -702,18 +824,18 @@ def batch_norm(
 
     def f(a, m, v, *wb):
         dtype = a.dtype
-        a32 = a.astype(jnp.float32)
-        out = (a32 - m.reshape(shape).astype(jnp.float32)) * lax.rsqrt(
-            v.reshape(shape).astype(jnp.float32) + epsilon
-        )
-        out = out.astype(dtype)
+        m32 = m.astype(jnp.float32)
+        inv = lax.rsqrt(v.astype(jnp.float32) + epsilon)
         i = 0
         if has_w:
-            out = out * wb[i].reshape(shape).astype(dtype)
+            inv = inv * wb[i].astype(jnp.float32)
             i += 1
+        shift = -m32 * inv
         if has_b:
-            out = out + wb[i].reshape(shape).astype(dtype)
-        return out
+            shift = shift + wb[i].astype(jnp.float32)
+        # one FMA per element; per-channel scale/shift precomputed on [C]
+        out = a.astype(jnp.float32) * inv.reshape(shape) + shift.reshape(shape)
+        return out.astype(dtype)
 
     return apply(f, ins, name="batch_norm")
 
@@ -919,6 +1041,10 @@ def cross_entropy(
                 loss = (1 - label_smoothing) * (-picked) + label_smoothing * smooth
             else:
                 loss = -picked
+            if use_softmax:
+                # softmax CE is >= 0 exactly; XLA's fused bf16 rounding can
+                # leave -ulp noise on fully-confident samples — clamp it
+                loss = jnp.maximum(loss, 0.0)
             loss = loss * valid
             if w:
                 cw = jnp.take(w[0], safe_idx, axis=0).astype(jnp.float32) * valid

@@ -21,9 +21,10 @@ class MaxPool2D(Layer):
         super().__init__()
         self.k, self.s, self.p = kernel_size, stride, padding
         self.return_mask = return_mask
+        self.data_format = data_format
 
     def forward(self, x):
-        return F.max_pool2d(x, self.k, self.s, self.p, return_mask=self.return_mask)
+        return F.max_pool2d(x, self.k, self.s, self.p, return_mask=self.return_mask, data_format=self.data_format)
 
 
 class AvgPool1D(Layer):
@@ -42,9 +43,10 @@ class AvgPool2D(Layer):
         self.k, self.s, self.p = kernel_size, stride, padding
         self.exclusive = exclusive
         self.divisor_override = divisor_override
+        self.data_format = data_format
 
     def forward(self, x):
-        return F.avg_pool2d(x, self.k, self.s, self.p, exclusive=self.exclusive, divisor_override=self.divisor_override)
+        return F.avg_pool2d(x, self.k, self.s, self.p, exclusive=self.exclusive, divisor_override=self.divisor_override, data_format=self.data_format)
 
 
 class AdaptiveAvgPool1D(Layer):
@@ -60,9 +62,10 @@ class AdaptiveAvgPool2D(Layer):
     def __init__(self, output_size, data_format="NCHW", name=None):
         super().__init__()
         self.output_size = output_size
+        self.data_format = data_format
 
     def forward(self, x):
-        return F.adaptive_avg_pool2d(x, self.output_size)
+        return F.adaptive_avg_pool2d(x, self.output_size, data_format=self.data_format)
 
 
 class AdaptiveMaxPool2D(Layer):

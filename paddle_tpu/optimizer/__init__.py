@@ -100,7 +100,12 @@ class Optimizer:
         return lr() if isinstance(lr, LRScheduler) else float(lr)
 
     def _sync_lr(self):
-        self._lr_t._raw = jnp.asarray(self._initial_lr_value(self._learning_rate), jnp.float32)
+        v = float(self._initial_lr_value(self._learning_rate))
+        # only touch the device scalar when the LR actually changed — a fresh
+        # jnp.asarray per step is an extra dispatched program in the hot loop
+        if self._lr_t._raw is None or getattr(self, "_lr_synced_value", None) != v:
+            self._lr_t._raw = jnp.asarray(v, jnp.float32)
+            self._lr_synced_value = v
 
     def get_lr(self):
         if isinstance(self._learning_rate, LRScheduler):

@@ -1,7 +1,9 @@
 """ResNet family (reference: python/paddle/vision/models/resnet.py — config 2).
 
-Standard torchvision-style topology under the paddle API; BN layouts NCHW
-(XLA re-lays out for TPU automatically)."""
+Standard torchvision-style topology under the paddle API.  `data_format`
+selects NCHW (paddle default) or NHWC; NHWC is the TPU-native layout
+(channels on the minor/lane axis) and is what the AMP-O2 benchmark uses —
+it avoids any layout surprises in XLA's conv handling."""
 
 from __future__ import annotations
 
@@ -11,14 +13,17 @@ from ... import nn
 class BasicBlock(nn.Layer):
     expansion = 1
 
-    def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1, base_width=64, dilation=1, norm_layer=None):
+    def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1, base_width=64, dilation=1, norm_layer=None, data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
-        self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride, padding=1, bias_attr=False)
-        self.bn1 = norm_layer(planes)
+        # only forward data_format when non-default so user-supplied
+        # norm_layer callables without that kwarg keep working
+        df = {} if data_format == "NCHW" else dict(data_format=data_format)
+        self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride, padding=1, bias_attr=False, **df)
+        self.bn1 = norm_layer(planes, **df)
         self.relu = nn.ReLU()
-        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False)
-        self.bn2 = norm_layer(planes)
+        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False, **df)
+        self.bn2 = norm_layer(planes, **df)
         self.downsample = downsample
         self.stride = stride
 
@@ -34,16 +39,17 @@ class BasicBlock(nn.Layer):
 class BottleneckBlock(nn.Layer):
     expansion = 4
 
-    def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1, base_width=64, dilation=1, norm_layer=None):
+    def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1, base_width=64, dilation=1, norm_layer=None, data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
-        self.bn1 = norm_layer(width)
-        self.conv2 = nn.Conv2D(width, width, 3, stride=stride, padding=dilation, groups=groups, dilation=dilation, bias_attr=False)
-        self.bn2 = norm_layer(width)
-        self.conv3 = nn.Conv2D(width, planes * self.expansion, 1, bias_attr=False)
-        self.bn3 = norm_layer(planes * self.expansion)
+        df = {} if data_format == "NCHW" else dict(data_format=data_format)
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False, **df)
+        self.bn1 = norm_layer(width, **df)
+        self.conv2 = nn.Conv2D(width, width, 3, stride=stride, padding=dilation, groups=groups, dilation=dilation, bias_attr=False, **df)
+        self.bn2 = norm_layer(width, **df)
+        self.conv3 = nn.Conv2D(width, planes * self.expansion, 1, bias_attr=False, **df)
+        self.bn3 = norm_layer(planes * self.expansion, **df)
         self.relu = nn.ReLU()
         self.downsample = downsample
 
@@ -58,8 +64,9 @@ class BottleneckBlock(nn.Layer):
 
 
 class ResNet(nn.Layer):
-    def __init__(self, block, depth=50, width=64, num_classes=1000, with_pool=True, groups=1):
+    def __init__(self, block, depth=50, width=64, num_classes=1000, with_pool=True, groups=1, data_format="NCHW"):
         super().__init__()
+        self.data_format = data_format
         layer_cfg = {
             18: [2, 2, 2, 2],
             34: [3, 4, 6, 3],
@@ -75,32 +82,34 @@ class ResNet(nn.Layer):
         self.inplanes = 64
         self.dilation = 1
 
-        self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3, bias_attr=False)
-        self.bn1 = nn.BatchNorm2D(self.inplanes)
+        df = {} if data_format == "NCHW" else dict(data_format=data_format)
+        self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3, bias_attr=False, **df)
+        self.bn1 = nn.BatchNorm2D(self.inplanes, **df)
         self.relu = nn.ReLU()
-        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1, **df)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
         if with_pool:
-            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1), **df)
         if num_classes > 0:
             self.fc = nn.Linear(512 * block.expansion, num_classes)
 
     def _make_layer(self, block, planes, blocks, stride=1):
+        dfk = {} if self.data_format == "NCHW" else dict(data_format=self.data_format)
         downsample = None
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential(
-                nn.Conv2D(self.inplanes, planes * block.expansion, 1, stride=stride, bias_attr=False),
-                nn.BatchNorm2D(planes * block.expansion),
+                nn.Conv2D(self.inplanes, planes * block.expansion, 1, stride=stride, bias_attr=False, **dfk),
+                nn.BatchNorm2D(planes * block.expansion, **dfk),
             )
         layers = [
-            block(self.inplanes, planes, stride, downsample, self.groups, self.base_width, self.dilation)
+            block(self.inplanes, planes, stride, downsample, self.groups, self.base_width, self.dilation, **dfk)
         ]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
-            layers.append(block(self.inplanes, planes, groups=self.groups, base_width=self.base_width))
+            layers.append(block(self.inplanes, planes, groups=self.groups, base_width=self.base_width, **dfk))
         return nn.Sequential(*layers)
 
     def forward(self, x):

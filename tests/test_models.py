@@ -66,6 +66,67 @@ class TestResNet:
         out.sum().backward()
         assert model.conv1.weight.grad is not None
 
+    def test_resnet_nhwc_matches_nchw(self):
+        # data_format="NHWC" (the TPU-native layout the benchmark uses) must
+        # match NCHW numerically in both train (batch-stats BN) and eval
+        from paddle_tpu.vision.models import resnet18
+
+        rng = np.random.RandomState(0)
+        x = rng.rand(4, 3, 64, 64).astype(np.float32)
+        paddle.seed(0)
+        m1 = resnet18(num_classes=10)
+        paddle.seed(0)
+        m2 = resnet18(num_classes=10, data_format="NHWC")
+        xh = np.ascontiguousarray(np.transpose(x, (0, 2, 3, 1)))
+        # eval with fresh stats: BN is a fixed affine, layout bugs (channel
+        # mixups) would show as O(1) errors — tight tolerance
+        m1.eval()
+        m2.eval()
+        o1 = m1(paddle.to_tensor(x)).numpy()
+        o2 = m2(paddle.to_tensor(xh)).numpy()
+        np.testing.assert_allclose(o1, o2, rtol=1e-4, atol=1e-4)
+        # train-mode batch-stat BN amplifies fp32 reduction-order noise
+        # through rsqrt(var+eps) on near-dead channels (random weights, few
+        # elements per channel), so cross-layout agreement is inherently
+        # loose here; real layout bugs still produce O(1) errors.  Absolute
+        # numerics vs the reference are gated by bench.py's loss parity.
+        m1.train()
+        m2.train()
+        o1 = m1(paddle.to_tensor(x)).numpy()
+        o2 = m2(paddle.to_tensor(xh)).numpy()
+        np.testing.assert_allclose(o1, o2, rtol=5e-2, atol=5e-2)
+
+    def test_stem_space_to_depth_rewrite(self):
+        # low-channel strided convs are rewritten via space-to-depth; the
+        # rewrite must be numerically exact vs the direct conv, fwd and grad
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 3, 32, 32).astype(np.float32)
+        w = (rng.rand(16, 3, 7, 7).astype(np.float32) - 0.5)
+        plan = F._space_to_depth_plan((2, 3, 32, 32), w.shape, (2, 2), [(3, 3), (3, 3)], (1, 1), 1, "NCHW")
+        assert plan is not None
+        ref = lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (2, 2), [(3, 3), (3, 3)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        got = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), stride=2, padding=3).numpy()
+        np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+        wt = paddle.to_tensor(w)
+        wt.stop_gradient = False
+        F.conv2d(paddle.to_tensor(x), wt, stride=2, padding=3).sum().backward()
+
+        def ref_loss(wj):
+            return lax.conv_general_dilated(
+                jnp.asarray(x), wj, (2, 2), [(3, 3), (3, 3)],
+                dimension_numbers=("NCHW", "OIHW", "NCHW")).sum()
+
+        g_ref = jax.grad(ref_loss)(jnp.asarray(w))
+        np.testing.assert_allclose(wt.grad.numpy(), np.asarray(g_ref), rtol=1e-4, atol=1e-4)
+
 
 class TestLlama:
     def test_loss_decreases_compiled(self):

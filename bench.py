@@ -208,7 +208,7 @@ def bench_bert():
     on_tpu = _on_tpu()
     if on_tpu:
         cfg = BertConfig.bert_base(hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
-        batch, seqlen, steps = 32, 384, 10
+        batch, seqlen, steps = 32, 384, 30
     else:
         cfg = BertConfig.tiny()
         batch, seqlen, steps = 4, 64, 2

@@ -541,25 +541,40 @@ def conv2d_transpose(
 # ---------------------------------------------------------------------------
 
 
-def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False, data_format="NCHW", name=None):
-    x = coerce(x)
+def _pool2d_spec(kernel_size, stride, padding, nhwc):
+    """Shared window/stride/padding construction for the 2D pools.
+
+    Returns (k, s, pad_spec, dims, strides).  A 4-pair paddle-style padding
+    list is given in the data layout's order, so the spatial pairs are at
+    [2:4] for NCHW but [1:3] for NHWC."""
     k = _tuplize(kernel_size, 2)
     s = _tuplize(stride if stride is not None else kernel_size, 2)
+    if (
+        nhwc
+        and isinstance(padding, (list, tuple))
+        and len(padding) == 4
+        and isinstance(padding[0], (list, tuple))
+    ):
+        padding = [padding[0], padding[3], padding[1], padding[2]]  # -> NCHW order
     pad = _conv_padding(padding, 2, s, k, (1, 1))
-    nhwc = data_format == "NHWC"
     if isinstance(pad, str):
         pad_spec = pad
     elif nhwc:
         pad_spec = [(0, 0)] + list(pad) + [(0, 0)]
     else:
         pad_spec = [(0, 0), (0, 0)] + list(pad)
+    dims = (1,) + k + (1,) if nhwc else (1, 1) + k
+    strides = (1,) + s + (1,) if nhwc else (1, 1) + s
+    return k, s, pad_spec, dims, strides
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False, data_format="NCHW", name=None):
+    x = coerce(x)
+    k, s, pad_spec, dims, strides = _pool2d_spec(kernel_size, stride, padding, data_format == "NHWC")
 
     def f(a):
-        dims = (1,) + k + (1,) if nhwc else (1, 1) + k
-        strides = (1,) + s + (1,) if nhwc else (1, 1) + s
-        p = pad_spec if isinstance(pad_spec, str) else pad_spec
         init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
-        return lax.reduce_window(a, init, lax.max, dims, strides, p)
+        return lax.reduce_window(a, init, lax.max, dims, strides, pad_spec)
 
     out = apply(f, [x], name="max_pool2d")
     if return_mask:
@@ -570,20 +585,9 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_m
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
     x = coerce(x)
-    k = _tuplize(kernel_size, 2)
-    s = _tuplize(stride if stride is not None else kernel_size, 2)
-    pad = _conv_padding(padding, 2, s, k, (1, 1))
-    nhwc = data_format == "NHWC"
-    if isinstance(pad, str):
-        pad_spec = pad
-    elif nhwc:
-        pad_spec = [(0, 0)] + list(pad) + [(0, 0)]
-    else:
-        pad_spec = [(0, 0), (0, 0)] + list(pad)
+    k, s, pad_spec, dims, strides = _pool2d_spec(kernel_size, stride, padding, data_format == "NHWC")
 
     def f(a):
-        dims = (1,) + k + (1,) if nhwc else (1, 1) + k
-        strides = (1,) + s + (1,) if nhwc else (1, 1) + s
         summed = lax.reduce_window(a, 0.0, lax.add, dims, strides, pad_spec)
         if divisor_override:
             return summed / divisor_override

@@ -10,15 +10,19 @@ from __future__ import annotations
 from ... import nn
 
 
+def _df_kwargs(data_format):
+    """Forward data_format only when non-default so user-supplied norm_layer
+    callables without that kwarg keep working."""
+    return {} if data_format == "NCHW" else dict(data_format=data_format)
+
+
 class BasicBlock(nn.Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1, base_width=64, dilation=1, norm_layer=None, data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
-        # only forward data_format when non-default so user-supplied
-        # norm_layer callables without that kwarg keep working
-        df = {} if data_format == "NCHW" else dict(data_format=data_format)
+        df = _df_kwargs(data_format)
         self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride, padding=1, bias_attr=False, **df)
         self.bn1 = norm_layer(planes, **df)
         self.relu = nn.ReLU()
@@ -43,7 +47,7 @@ class BottleneckBlock(nn.Layer):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
         width = int(planes * (base_width / 64.0)) * groups
-        df = {} if data_format == "NCHW" else dict(data_format=data_format)
+        df = _df_kwargs(data_format)
         self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False, **df)
         self.bn1 = norm_layer(width, **df)
         self.conv2 = nn.Conv2D(width, width, 3, stride=stride, padding=dilation, groups=groups, dilation=dilation, bias_attr=False, **df)
@@ -82,7 +86,7 @@ class ResNet(nn.Layer):
         self.inplanes = 64
         self.dilation = 1
 
-        df = {} if data_format == "NCHW" else dict(data_format=data_format)
+        df = _df_kwargs(data_format)
         self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3, bias_attr=False, **df)
         self.bn1 = nn.BatchNorm2D(self.inplanes, **df)
         self.relu = nn.ReLU()
@@ -97,7 +101,7 @@ class ResNet(nn.Layer):
             self.fc = nn.Linear(512 * block.expansion, num_classes)
 
     def _make_layer(self, block, planes, blocks, stride=1):
-        dfk = {} if self.data_format == "NCHW" else dict(data_format=self.data_format)
+        dfk = _df_kwargs(self.data_format)
         downsample = None
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential(

@@ -103,6 +103,26 @@ class TestFunctional:
         out = F.adaptive_avg_pool2d(t(x), (2, 2)).numpy()
         np.testing.assert_allclose(out[0, 0, 0, 0], x[0, 0, :3, :3].mean(), rtol=1e-5)
 
+    def test_pools_nhwc_matches_nchw(self):
+        # NHWC pooling must match NCHW for every padding style, including
+        # 4-pair paddle-style padding given in the data layout's order
+        x = np.arange(2 * 4 * 4 * 3, dtype=np.float32).reshape(2, 4, 4, 3)
+        xc = np.ascontiguousarray(np.transpose(x, (0, 3, 1, 2)))
+        for pad_nhwc, pad_nchw in [
+            (0, 0),
+            (1, 1),
+            ([[0, 0], [1, 1], [1, 1], [0, 0]], [[0, 0], [0, 0], [1, 1], [1, 1]]),
+        ]:
+            mh = F.max_pool2d(t(x), 2, 2, padding=pad_nhwc, data_format="NHWC").numpy()
+            mc = F.max_pool2d(t(xc), 2, 2, padding=pad_nchw).numpy()
+            np.testing.assert_array_equal(np.transpose(mh, (0, 3, 1, 2)), mc)
+            ah = F.avg_pool2d(t(x), 2, 2, padding=pad_nhwc, data_format="NHWC").numpy()
+            ac = F.avg_pool2d(t(xc), 2, 2, padding=pad_nchw).numpy()
+            np.testing.assert_allclose(np.transpose(ah, (0, 3, 1, 2)), ac, rtol=1e-6)
+        oh = F.adaptive_avg_pool2d(t(x), (2, 2), data_format="NHWC").numpy()
+        oc = F.adaptive_avg_pool2d(t(xc), (2, 2)).numpy()
+        np.testing.assert_allclose(np.transpose(oh, (0, 3, 1, 2)), oc, rtol=1e-6)
+
     def test_layer_norm(self):
         x = np.random.rand(2, 5).astype(np.float32)
         out = F.layer_norm(t(x), 5).numpy()

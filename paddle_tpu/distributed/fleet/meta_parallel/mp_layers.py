@@ -113,19 +113,46 @@ class VocabParallelEmbedding(Layer):
 
 class ParallelCrossEntropy(Layer):
     """Cross entropy over class-sharded logits (reference:
-    mp_ops._c_softmax_with_cross_entropy).  GSPMD partitions the logsumexp."""
+    mp_ops._c_softmax_with_cross_entropy).
+
+    The vocab axis stays sharded on 'mp' END TO END: per-shard max / exp /
+    sum reduce under explicit sharding constraints (GSPMD inserts the small
+    [tokens]-sized allreduces — the reference's custom NCCL op), and the
+    label pick is a one-hot contraction rather than take_along_axis, which
+    would force GSPMD to gather the full [tokens, vocab] logits onto every
+    device.  No replicated [tokens, vocab] buffer exists in the compiled
+    step (asserted on the HLO text in tests/test_models.py::TestLlama::
+    test_parallel_ce_tp8_matches_dense_and_stays_sharded).
+
+    Returns per-token loss [..., 1] like the reference (reduce it yourself).
+    """
 
     def __init__(self, mp_group=None, name=None, ignore_index=-100):
         super().__init__()
         self.ignore_index = ignore_index
 
     def forward(self, input, label):
-        loss = F.cross_entropy(
-            input, label, reduction="none", ignore_index=self.ignore_index
-        )
-        from ....ops.manipulation import unsqueeze
+        input, label = coerce(input), coerce(label)
+        ignore_index = self.ignore_index
 
-        return unsqueeze(loss, -1)
+        def f(logits, lab):
+            lead = (None,) * (logits.ndim - 1)
+            logits = _mesh.constraint(logits, P(*lead, "mp"))
+            l32 = logits.astype(jnp.float32)
+            lab2 = lab[..., 0] if (lab.ndim == l32.ndim and lab.shape[-1] == 1) else lab
+            idx = lab2.astype(jnp.int32)
+            valid = idx != ignore_index
+            safe = jnp.where(valid, idx, 0)
+            m = jnp.max(l32, axis=-1)  # [tokens] — per-shard max + tiny allreduce
+            e = _mesh.constraint(jnp.exp(l32 - m[..., None]), P(*lead, "mp"))
+            lse = m + jnp.log(jnp.sum(e, axis=-1))
+            vocab_iota = jax.lax.broadcasted_iota(jnp.int32, l32.shape, l32.ndim - 1)
+            onehot = _mesh.constraint(vocab_iota == safe[..., None], P(*lead, "mp"))
+            picked = jnp.sum(jnp.where(onehot, l32, 0.0), axis=-1)
+            loss = jnp.maximum(lse - picked, 0.0) * valid.astype(jnp.float32)
+            return loss[..., None]
+
+        return apply(f, [input, label], name="parallel_cross_entropy")
 
 
 class ParallelColumnLinear(ColumnParallelLinear):

@@ -266,9 +266,10 @@ class StaticFunction:
         return entry
 
     # -- call -----------------------------------------------------------
-    def __call__(self, *args, **kwargs):
-        if _core.active_trace() is not None:
-            return self._fn(*args, **kwargs)  # nested to_static: inline
+    def _prepare(self, args, kwargs):
+        """Resolve the cache entry and gather (arg, ro-state, rw-state)
+        arrays, re-tracing if the state layout went stale (e.g. grads
+        cleared differently than at trace time)."""
         _run_refreshers()
         key = _struct_signature((args, tuple(sorted(kwargs.items()))))
         entry = self._cache.get(key)
@@ -279,22 +280,25 @@ class StaticFunction:
         in_tensors = []
         _flatten_structure((args, kwargs), in_tensors)
         arg_arrays = [t._raw for t in in_tensors]
-        ro_vals, rw_vals = [], []
-        stale = False
-        for (t, kind), rw in zip(entry.state_in, entry.rw_flags):
-            v = t._raw if kind == "data" else t._grad_raw
-            if v is None:
-                stale = True
-                break
-            (rw_vals if rw else ro_vals).append(v)
-        if stale:
-            # state layout changed (e.g. grads cleared differently) — re-trace
-            entry = self._trace(args, kwargs)
-            self._cache[key] = entry
+        for attempt in range(2):
             ro_vals, rw_vals = [], []
+            stale = False
             for (t, kind), rw in zip(entry.state_in, entry.rw_flags):
                 v = t._raw if kind == "data" else t._grad_raw
+                if v is None:
+                    stale = True
+                    break
                 (rw_vals if rw else ro_vals).append(v)
+            if not stale or attempt == 1:
+                break
+            entry = self._trace(args, kwargs)
+            self._cache[key] = entry
+        return entry, arg_arrays, ro_vals, rw_vals
+
+    def __call__(self, *args, **kwargs):
+        if _core.active_trace() is not None:
+            return self._fn(*args, **kwargs)  # nested to_static: inline
+        entry, arg_arrays, ro_vals, rw_vals = self._prepare(args, kwargs)
 
         out_arrays, state_vals, nan_flags = entry.jitted(arg_arrays, ro_vals, rw_vals)
 
@@ -336,6 +340,13 @@ class StaticFunction:
 
     def clear_cache(self):
         self._cache.clear()
+
+    def lowered_text(self, *args, **kwargs):
+        """Optimized-HLO text of the compiled step for the given inputs —
+        the §4 test mechanism of asserting on the partitioned program
+        (shard shapes, inserted collectives) instead of numerics."""
+        entry, arg_arrays, ro_vals, rw_vals = self._prepare(args, kwargs)
+        return entry.jitted.lower(arg_arrays, ro_vals, rw_vals).compile().as_text()
 
     @property
     def code(self):

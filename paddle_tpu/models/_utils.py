@@ -1,0 +1,21 @@
+"""Shared model helpers."""
+
+from __future__ import annotations
+
+from .. import ops
+from ..nn import functional as F
+
+
+def sequence_ce(model, logits, labels, ignore_index=-100):
+    """Mean CE over non-ignored tokens.  Routes through the model's
+    ParallelCrossEntropy (vocab stays mp-sharded, reference
+    mp_ops._c_softmax_with_cross_entropy) when it was built under tensor
+    parallelism; both paths divide by the count of valid tokens so TP and
+    dense losses match with padded (-100) labels."""
+    vocab = model.config.vocab_size
+    flat = labels.reshape([-1])
+    if getattr(model, "parallel_ce", None) is not None:
+        per_tok = model.parallel_ce(logits.reshape([-1, vocab]), flat).reshape([-1])
+        valid = (flat != ignore_index).astype(per_tok.dtype)
+        return per_tok.sum() / ops.clip(valid.sum(), min=1.0)
+    return F.cross_entropy(logits.reshape([-1, vocab]), flat, ignore_index=ignore_index)

@@ -19,6 +19,7 @@ from ..distributed import mesh as _mesh
 from ..distributed.fleet.meta_parallel import (
     ColumnParallelLinear,
     LayerDesc,
+    ParallelCrossEntropy,
     PipelineLayer,
     RowParallelLinear,
     VocabParallelEmbedding,
@@ -29,6 +30,7 @@ from ..distributed.fleet.meta_parallel.pp_spmd import (
     virtual_layer_order,
 )
 from ..nn import functional as F
+from ._utils import sequence_ce
 from ..nn import initializer as I
 from ..ops.dispatch import apply as _dispatch_apply
 from ..ops.flash_attention import sdpa_array
@@ -171,17 +173,19 @@ class GPTForCausalLM(nn.Layer):
         self.config = config
         self.gpt = GPTModel(config)
         if _use_tp(config):
-            self.lm_head = ColumnParallelLinear(config.hidden_size, config.vocab_size, has_bias=False, gather_output=True)
+            # vocab-sharded head + sharded-logsumexp CE — no replicated
+            # [B*S, vocab] logits (mp_ops._c_softmax_with_cross_entropy)
+            self.lm_head = ColumnParallelLinear(config.hidden_size, config.vocab_size, has_bias=False, gather_output=False)
+            self.parallel_ce = ParallelCrossEntropy()
         else:
             self.lm_head = nn.Linear(config.hidden_size, config.vocab_size, bias_attr=False)
+            self.parallel_ce = None
 
     def forward(self, input_ids, labels=None):
         hidden = self.gpt(input_ids)
         logits = self.lm_head(hidden)
         if labels is not None:
-            loss = F.cross_entropy(
-                logits.reshape([-1, self.config.vocab_size]), labels.reshape([-1])
-            )
+            loss = sequence_ce(self, logits, labels)
             return loss, logits
         return logits
 
@@ -397,10 +401,12 @@ class GPTForCausalLMSpmdPipe(nn.Layer):
         self.ln_f = nn.LayerNorm(config.hidden_size, config.layer_norm_epsilon)
         if _use_tp(config):
             self.lm_head = ColumnParallelLinear(
-                config.hidden_size, config.vocab_size, has_bias=False, gather_output=True
+                config.hidden_size, config.vocab_size, has_bias=False, gather_output=False
             )
+            self.parallel_ce = ParallelCrossEntropy()
         else:
             self.lm_head = nn.Linear(config.hidden_size, config.vocab_size, bias_attr=False)
+            self.parallel_ce = None
 
     def forward(self, input_ids, labels=None):
         x = self.embeddings(input_ids)
@@ -409,9 +415,7 @@ class GPTForCausalLMSpmdPipe(nn.Layer):
         x = self.ln_f(x)
         logits = self.lm_head(x)
         if labels is not None:
-            loss = F.cross_entropy(
-                logits.reshape([-1, self.config.vocab_size]), labels.reshape([-1])
-            )
+            loss = sequence_ce(self, logits, labels)
             return loss, logits
         return logits
 

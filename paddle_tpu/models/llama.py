@@ -19,10 +19,12 @@ from .. import nn, ops
 from ..distributed import mesh as _mesh
 from ..distributed.fleet.meta_parallel import (
     ColumnParallelLinear,
+    ParallelCrossEntropy,
     RowParallelLinear,
     VocabParallelEmbedding,
 )
 from ..nn import functional as F
+from ._utils import sequence_ce
 from ..tensor import Tensor
 
 
@@ -276,21 +278,22 @@ class LlamaForCausalLM(nn.Layer):
         self.config = config
         self.llama = LlamaModel(config)
         if _use_tp(config):
+            # vocab-sharded head + sharded-logsumexp CE: the full replicated
+            # [B*S, vocab] logits never materialize (reference:
+            # mp_ops._c_softmax_with_cross_entropy's fused NCCL op)
             self.lm_head = ColumnParallelLinear(
-                config.hidden_size, config.vocab_size, has_bias=False, gather_output=True
+                config.hidden_size, config.vocab_size, has_bias=False, gather_output=False
             )
+            self.parallel_ce = ParallelCrossEntropy(ignore_index=-100)
         else:
             self.lm_head = nn.Linear(config.hidden_size, config.vocab_size, bias_attr=False)
+            self.parallel_ce = None
 
     def forward(self, input_ids, labels=None, attn_mask=None):
         hidden = self.llama(input_ids, attn_mask)
         logits = self.lm_head(hidden)
         if labels is not None:
-            loss = F.cross_entropy(
-                logits.reshape([-1, self.config.vocab_size]),
-                labels.reshape([-1]),
-                ignore_index=-100,
-            )
+            loss = sequence_ce(self, logits, labels)
             return loss, logits
         return logits
 

@@ -184,6 +184,38 @@ class TestLlama:
         w = model.llama.layers[0].mlp.gate_proj.weight
         assert w._raw.sharding.shard_shape(w._raw.shape)[1] == cfg.intermediate_size // 8
 
+    def test_parallel_ce_tp8_matches_dense_and_stays_sharded(self):
+        # vocab-parallel CE (mp_ops._c_softmax_with_cross_entropy parity):
+        # 1) TP=8 loss == dense loss with identical weights
+        # 2) the compiled TP step contains NO replicated [tokens, vocab]
+        #    buffer — the sharded logsumexp keeps vocab mp-sharded end-to-end
+        paddle.seed(7)
+        cfg = LlamaConfig.tiny()
+        dense = LlamaForCausalLM(cfg)
+        data = ids(3, 16)  # tokens = 48, distinct from every model dim
+        ref_loss, _ = dense(data, labels=data)
+        ref = float(ref_loss.numpy())
+
+        pmesh.build_mesh(mp=8)
+        paddle.seed(7)
+        cfg_tp = LlamaConfig.tiny(tensor_parallel_degree=8)
+        tp = LlamaForCausalLM(cfg_tp)
+
+        @paddle.jit.to_static
+        def step(x):
+            loss, _ = tp(x, labels=x)
+            return loss
+
+        got = float(step(data).numpy())
+        assert abs(got - ref) / abs(ref) < 2e-3, (got, ref)
+
+        text = step.lowered_text(data)
+        # per-device shard of the [48, 256-vocab] logits is [48, 32]; a full
+        # [48, 256] f32/bf16 buffer would mean GSPMD replicated the logits
+        for bad in ("f32[48,256]", "bf16[48,256]", "f32[3,16,256]", "bf16[3,16,256]"):
+            assert bad not in text, f"replicated logits buffer {bad} in TP step"
+        assert "f32[48,32]" in text or "bf16[48,32]" in text
+
     def test_generate(self):
         cfg = LlamaConfig.tiny()
         model = LlamaForCausalLM(cfg)

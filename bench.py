@@ -197,6 +197,51 @@ def bench_resnet50():
 
 
 # ---------------------------------------------------------------------------
+# decode: compiled static-KV-cache generation (inference runtime, SURVEY L8)
+# ---------------------------------------------------------------------------
+
+
+def bench_llama_decode():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    on_tpu = _on_tpu()
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000,
+            hidden_size=2048,
+            intermediate_size=5632,
+            num_hidden_layers=12,
+            num_attention_heads=16,
+            num_key_value_heads=16,
+            max_position_embeddings=2048,
+        )
+        batch, prompt, new_toks = 8, 128, 128
+    else:
+        cfg = LlamaConfig.tiny()
+        batch, prompt, new_toks = 2, 8, 8
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model = paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, prompt)).astype(np.int32))
+    model.generate(ids, max_new_tokens=new_toks).numpy()  # compile prefill+decode
+    t0 = time.perf_counter()
+    model.generate(ids, max_new_tokens=new_toks).numpy()  # sync before stopping the clock
+    dt = time.perf_counter() - t0
+    tok_s = batch * new_toks / dt
+    return {
+        "metric": "llama_decode_tokens_per_sec",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        "compiles": model._gen_fns["decode_greedy"].trace_count,
+        "note": "1.3B-class model, batch 8, static-KV compiled decode step",
+    }
+
+
+# ---------------------------------------------------------------------------
 # config 3: BERT-base (SQuAD-shaped QA head, seq 384)
 # ---------------------------------------------------------------------------
 
@@ -341,6 +386,7 @@ def main():
     for name, fn in (
         ("resnet50_amp_o2", bench_resnet50),
         ("bert_base_qa", bench_bert),
+        ("llama_decode", bench_llama_decode),
     ):
         try:
             configs[name] = fn()

@@ -112,6 +112,29 @@ def create_predictor(config):
     return Predictor(config)
 
 
+class GenerationPredictor:
+    """Serves autoregressive decoding over a model's compiled static-KV
+    decode step (models/llama.py StaticKVCache): the first request compiles
+    prefill+decode once; every later token — and every later request with
+    the same batch/cache bucket — reuses the same two executables.
+    (Reference capability: the inference runtime's flash-decode serving
+    path, SURVEY §2.1 L8.)"""
+
+    def __init__(self, model, max_new_tokens=32):
+        self.model = model
+        self.max_new_tokens = max_new_tokens
+
+    def generate(self, input_ids, max_new_tokens=None, temperature=0.0):
+        ids = np.asarray(input_ids, np.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        n = self.max_new_tokens if max_new_tokens is None else int(max_new_tokens)
+        out = self.model.generate(
+            Tensor(ids), max_new_tokens=n, temperature=float(temperature)
+        )
+        return np.asarray(out.numpy())
+
+
 def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True):
     """Minimal serving loop over a compiled program (reference capability:
     the AnalysisPredictor behind paddle_serving — SURVEY.md §2.1 "Inference
@@ -126,7 +149,7 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True):
 
     predictor = (
         path_or_predictor
-        if isinstance(path_or_predictor, Predictor)
+        if isinstance(path_or_predictor, (Predictor, GenerationPredictor))
         else Predictor(path_or_predictor)
     )
     lock = threading.Lock()
@@ -150,8 +173,22 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True):
                 self._reply(404, {"error": "use POST /predict"})
 
         def do_POST(self):
-            if self.path != "/predict":
-                self._reply(404, {"error": "use POST /predict"})
+            if self.path == "/generate" and isinstance(predictor, GenerationPredictor):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n))
+                    with lock:
+                        toks = predictor.generate(
+                            req["input_ids"],
+                            max_new_tokens=req.get("max_new_tokens"),
+                            temperature=req.get("temperature", 0.0),
+                        )
+                    self._reply(200, {"tokens": toks.tolist()})
+                except Exception as e:
+                    self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                return
+            if self.path != "/predict" or isinstance(predictor, GenerationPredictor):
+                self._reply(404, {"error": "use POST /predict or /generate"})
                 return
             try:
                 n = int(self.headers.get("Content-Length", 0))

@@ -159,10 +159,14 @@ class StaticFunction:
         self._fn = fn
         self._donate = donate
         self._cache = {}
+        # number of trace+compile events — tests assert the compiled decode
+        # path really is one executable for N tokens
+        self.trace_count = 0
         functools.update_wrapper(self, fn)
 
     # -- tracing --------------------------------------------------------
     def _trace(self, args, kwargs):
+        self.trace_count += 1
         fn = self._fn
         in_tensors = []
         args_tpl = _flatten_structure((args, kwargs), in_tensors)

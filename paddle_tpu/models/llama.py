@@ -89,16 +89,30 @@ def _rope_cache(config):
 
 
 def apply_rotary_pos_emb(q, k, cos, sin, position_offset=0):
-    """q,k: [b, s, h, d]; cos/sin: [max_pos, d] state tensors (rotate-half)."""
+    """q,k: [b, s, h, d]; cos/sin: [max_pos, d] state tensors (rotate-half).
+    position_offset may be a python int or a scalar int Tensor (the compiled
+    decode step passes the position as data so one executable serves every
+    token)."""
     import jax.numpy as jnp
+    from jax import lax
 
     from ..ops.dispatch import apply
 
     s = q.shape[1]
+    dyn = isinstance(position_offset, Tensor)
 
-    def f(qa, ka, c, si):
-        c = c[position_offset : position_offset + s][None, :, None, :].astype(qa.dtype)
-        si_ = si[position_offset : position_offset + s][None, :, None, :].astype(qa.dtype)
+    def f(qa, ka, c, si, *off_in):
+        if off_in:
+            # traced offset (compiled decode): cache bounds guarantee
+            # off + s <= max_pos, so the dynamic slice never clamps
+            c = lax.dynamic_slice_in_dim(c, off_in[0], s, 0)
+            si_ = lax.dynamic_slice_in_dim(si, off_in[0], s, 0)
+        else:
+            # static offset: plain slicing keeps the out-of-range case loud
+            c = c[position_offset : position_offset + s]
+            si_ = si[position_offset : position_offset + s]
+        c = c[None, :, None, :].astype(qa.dtype)
+        si_ = si_[None, :, None, :].astype(qa.dtype)
 
         def rot(x):
             half = x.shape[-1] // 2
@@ -107,7 +121,54 @@ def apply_rotary_pos_emb(q, k, cos, sin, position_offset=0):
 
         return rot(qa), rot(ka)
 
-    return apply(f, [q, k, cos, sin], multi=True, name="rope")
+    ins = [q, k, cos, sin] + ([position_offset] if dyn else [])
+    return apply(f, ins, multi=True, name="rope")
+
+
+class StaticKVCache:
+    """Preallocated [b, max_len, kv_heads, head_dim] K/V buffers updated in
+    place with dynamic_update_slice at the current position — shapes never
+    change, so ONE compiled decode step serves every generated token
+    (reference: the inference runtime's flash-decode KV cache, SURVEY §2.1
+    L8; the growing-concat Cache forced a recompile per token)."""
+
+    def __init__(self, b, max_len, kv_heads, head_dim, dtype="float32"):
+        from ..framework import core as _fcore
+
+        self.max_len = max_len
+        zeros = np.zeros((b, max_len, kv_heads, head_dim), _fcore.to_jax_dtype(dtype))
+        self.k = Tensor(zeros)
+        self.v = Tensor(zeros.copy())
+        self.k.stop_gradient = True
+        self.v.stop_gradient = True
+
+
+def _cache_write(cache_t, new_t, pos_t):
+    """dynamic_update_slice of this chunk's K or V at the absolute position."""
+    from jax import lax
+
+    from ..ops.dispatch import apply
+
+    def f(c, n, p):
+        return lax.dynamic_update_slice_in_dim(c, n.astype(c.dtype), p, 1)
+
+    return apply(f, [cache_t, new_t, pos_t], name="kv_cache_write")
+
+
+def _decode_mask(s, max_len, pos_t):
+    """Additive [1, 1, s, max_len] mask: query row i (absolute pos p+i) may
+    attend to cache slots j <= p+i; unwritten tail slots are masked out."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ops.dispatch import apply
+
+    def f(p):
+        i = lax.broadcasted_iota(jnp.int32, (s, max_len), 0)
+        j = lax.broadcasted_iota(jnp.int32, (s, max_len), 1)
+        return jnp.where(j <= i + p, 0.0, -1e30).astype(jnp.float32)[None, None]
+
+    return apply(f, [pos_t], name="decode_mask")
 
 
 class LlamaMLP(nn.Layer):
@@ -148,11 +209,20 @@ class LlamaAttention(nn.Layer):
             self.o_proj = nn.Linear(h, h, bias_attr=False)
         self.rope_cos, self.rope_sin = rope
 
-    def forward(self, x, attn_mask=None, cache=None):
+    def forward(self, x, attn_mask=None, cache=None, pos=None):
         b, s = x.shape[0], x.shape[1]
         q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
         k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        if isinstance(cache, StaticKVCache):
+            # compiled decode path: fixed-shape cache, position as data
+            q, k = apply_rotary_pos_emb(q, k, self.rope_cos, self.rope_sin, pos)
+            cache.k._data = _cache_write(cache.k, k, pos)._data
+            cache.v._data = _cache_write(cache.v, v, pos)._data
+            mask = _decode_mask(s, cache.max_len, pos)
+            out = F.scaled_dot_product_attention(q, cache.k, cache.v, attn_mask=mask)
+            out = out.reshape([b, s, self.num_heads * self.head_dim])
+            return self.o_proj(out), cache
         offset = 0
         if cache is not None:
             offset = cache[0].shape[1]
@@ -221,10 +291,10 @@ class LlamaDecoderLayer(nn.Layer):
         h = x + self.self_attn(self.input_layernorm(x), attn_mask)
         return h + self.mlp(self.post_attention_layernorm(h))
 
-    def forward(self, x, attn_mask=None, cache=None):
+    def forward(self, x, attn_mask=None, cache=None, pos=None):
         if cache is not None:
             residual = x
-            attn_out, new_cache = self.self_attn(self.input_layernorm(x), attn_mask, cache)
+            attn_out, new_cache = self.self_attn(self.input_layernorm(x), attn_mask, cache, pos)
             h = residual + attn_out
             out = h + self.mlp(self.post_attention_layernorm(h))
             return out, new_cache
@@ -249,7 +319,7 @@ class LlamaModel(nn.Layer):
         )
         self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
 
-    def forward(self, input_ids, attn_mask=None, caches=None):
+    def forward(self, input_ids, attn_mask=None, caches=None, pos=None):
         x = self.embed_tokens(input_ids)
         if self.config.sequence_parallel:
             from ..distributed.fleet.meta_parallel.sp_utils import ScatterOp
@@ -258,7 +328,7 @@ class LlamaModel(nn.Layer):
         new_caches = [] if caches is not None else None
         for i, layer in enumerate(self.layers):
             if caches is not None:
-                x, c = layer(x, attn_mask, caches[i])
+                x, c = layer(x, attn_mask, caches[i], pos)
                 new_caches.append(c)
             else:
                 x = layer(x, attn_mask)
@@ -297,29 +367,91 @@ class LlamaForCausalLM(nn.Layer):
             return loss, logits
         return logits
 
-    def generate(self, input_ids, max_new_tokens=16, temperature=0.0):
-        """Greedy/temperature sampling with KV cache."""
-        from .. import no_grad
+    def _gen_state(self, b, cache_len, dtype):
+        """Static KV caches + compiled prefill/decode steps, reused across
+        generate() calls.  The cache tensors are jit STATE (read + written
+        in place), so they must be the same objects every call; the decode
+        step compiles once and serves every token.  Greedy sampling and the
+        position increment live INSIDE the compiled step, so the hot loop
+        is exactly one executable dispatch per token — per-token eager ops
+        (argmax/concat/device scalars) measurably dominated decode latency
+        through the device transport."""
+        key = (b, cache_len, str(dtype))
+        if getattr(self, "_gen_cache_key", None) == key:
+            return self._gen_caches, self._gen_fns
+        from .. import jit
 
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        cache_dtype = self.lm_head.weight.dtype  # bf16 under AMP-O2 decorate
+        caches = [
+            StaticKVCache(b, cache_len, cfg.num_key_value_heads, head_dim, cache_dtype)
+            for _ in range(cfg.num_hidden_layers)
+        ]
+
+        def _step(toks, pos, greedy):
+            hidden, _ = self.llama(toks, caches=caches, pos=pos)
+            logits = self.lm_head(hidden)[:, -1]
+            new_pos = pos + toks.shape[1]
+            if greedy:
+                nxt = ops.argmax(logits, axis=-1, keepdim=True).astype(dtype)
+                return nxt, new_pos
+            return logits, new_pos
+
+        fns = {
+            "prefill_greedy": jit.to_static(lambda t, p: _step(t, p, True)),
+            "decode_greedy": jit.to_static(lambda t, p: _step(t, p, True)),
+            "prefill_logits": jit.to_static(lambda t, p: _step(t, p, False)),
+            "decode_logits": jit.to_static(lambda t, p: _step(t, p, False)),
+        }
+        self._gen_cache_key = key
+        self._gen_caches, self._gen_fns = caches, fns
+        return caches, fns
+
+    def generate(self, input_ids, max_new_tokens=16, temperature=0.0):
+        """Greedy/temperature sampling over a compiled decode step with a
+        preallocated static-shape KV cache: after the first token there are
+        ZERO recompiles and (greedy) ONE dispatch per token — the same
+        executable runs every step with the position carried as a device
+        scalar (reference: inference runtime flash-decode path, SURVEY
+        §2.1 L8)."""
+        from .. import no_grad, to_tensor
+
+        cfg = self.config
+        b, s0 = input_ids.shape[0], input_ids.shape[1]
+        if max_new_tokens <= 0:
+            return input_ids
+        # round the cache up to a 128 multiple so repeated generate() calls
+        # with nearby lengths reuse one compiled pair
+        want = min(cfg.max_position_embeddings, s0 + max_new_tokens)
+        cache_len = min(cfg.max_position_embeddings, -(-want // 128) * 128)
+        if s0 + max_new_tokens > cache_len:
+            import logging
+
+            logging.getLogger("paddle_tpu").warning(
+                "generate: prompt %d + max_new_tokens %d exceeds "
+                "max_position_embeddings %d; output truncated to %d new tokens",
+                s0, max_new_tokens, cfg.max_position_embeddings, max(cache_len - s0, 0),
+            )
         with no_grad():
-            caches = [
-                (
-                    ops.zeros([input_ids.shape[0], 0, self.config.num_key_value_heads, self.config.hidden_size // self.config.num_attention_heads], "float32"),
-                    ops.zeros([input_ids.shape[0], 0, self.config.num_key_value_heads, self.config.hidden_size // self.config.num_attention_heads], "float32"),
-                )
-                for _ in range(self.config.num_hidden_layers)
-            ]
-            tokens = input_ids
-            cur = input_ids
-            for _ in range(max_new_tokens):
-                hidden, caches = self.llama(cur, caches=caches)
-                logits = self.lm_head(hidden)[:, -1]
-                if temperature > 0:
+            caches, fns = self._gen_state(b, cache_len, input_ids.dtype)
+            pos0 = to_tensor(np.int32(0))
+            pieces = [input_ids]
+            if temperature <= 0:
+                nxt, pos = fns["prefill_greedy"](input_ids, pos0)
+                pieces.append(nxt)
+                for i in range(1, max_new_tokens):
+                    if s0 + i >= cache_len:
+                        break
+                    nxt, pos = fns["decode_greedy"](nxt, pos)
+                    pieces.append(nxt)
+            else:
+                logits, pos = fns["prefill_logits"](input_ids, pos0)
+                for i in range(max_new_tokens):
                     probs = F.softmax(logits / temperature, axis=-1)
-                    nxt = ops.multinomial(probs, 1)
-                else:
-                    nxt = ops.argmax(logits, axis=-1, keepdim=True)
-                nxt = nxt.astype(tokens.dtype)
-                tokens = ops.concat([tokens, nxt], axis=1)
-                cur = nxt
-            return tokens
+                    nxt = ops.multinomial(probs, 1).astype(input_ids.dtype)
+                    pieces.append(nxt)
+                    if i + 1 >= max_new_tokens or s0 + i + 1 >= cache_len:
+                        break
+                    logits, pos = fns["decode_logits"](nxt, pos)
+            return ops.concat(pieces, axis=1)

@@ -238,6 +238,11 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
                 return jax.lax.pmin(a, axis)
             if op == ReduceOp.AVG:
                 return jax.lax.pmean(a, axis)
+            if op == ReduceOp.PROD:
+                # no pprod primitive: all_gather the group then reduce —
+                # sign-safe (exp(psum(log)) would lose negatives/zeros)
+                gathered = jax.lax.all_gather(a, axis)
+                return jnp.prod(gathered, axis=0)
             raise ValueError(op)
         if d is not None:
             # per-rank blocks live on the axis shards: reduce them for real
@@ -403,8 +408,13 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
                 out._data = jax.device_put(out._raw, sh)
             inplace_rebind(tensor, out)
         else:
+            if len(tensor_list) != n:
+                raise ValueError(
+                    f"scatter needs len(tensor_list) == group size ({n}), "
+                    f"got {len(tensor_list)}"
+                )
             r = g.rank if g.rank >= 0 else 0
-            inplace_rebind(tensor, coerce(tensor_list[min(r, len(tensor_list) - 1)]))
+            inplace_rebind(tensor, coerce(tensor_list[r]))
     return Task([tensor])
 
 

@@ -59,6 +59,7 @@ def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
         pp_degree=hc.get("pp_degree", 1),
         sharding_degree=hc.get("sharding_degree", 1),
         sep_degree=hc.get("sep_degree", 1),
+        ep_degree=hc.get("ep_degree", 1),
     )
     set_hybrid_communicate_group(hcg)
     _fleet_state.update(initialized=True, strategy=strategy, hcg=hcg)

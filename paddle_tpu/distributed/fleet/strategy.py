@@ -54,7 +54,8 @@ class DistributedStrategy:
             pp_degree=1,
             sharding_degree=1,
             sep_degree=1,
-            order=["dp", "pp", "sharding", "sep", "mp"],
+            ep_degree=1,
+            order=["dp", "pp", "sharding", "sep", "ep", "mp"],
         )
         self.gradient_merge = False
         self.gradient_merge_configs = _Config(k_steps=1, avg=True)

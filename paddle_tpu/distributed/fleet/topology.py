@@ -16,7 +16,7 @@ from ..env import get_rank
 
 
 class CommunicateTopology:
-    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep", "model"), dims=(1, 1, 1, 1, 1)):
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep", "expert", "model"), dims=(1, 1, 1, 1, 1, 1)):
         self._parallel_names = list(hybrid_group_names)
         self._dims = list(dims)
 
@@ -37,37 +37,41 @@ _NAME2AXIS = {
     "pipe": "pp",
     "sharding": "sharding",
     "sep": "sep",
+    "expert": "ep",
     "model": "mp",
 }
 
 
 class HybridCommunicateGroup:
-    def __init__(self, topology=None, dp_degree=1, mp_degree=1, pp_degree=1, sharding_degree=1, sep_degree=1):
+    def __init__(self, topology=None, dp_degree=1, mp_degree=1, pp_degree=1, sharding_degree=1, sep_degree=1, ep_degree=1):
         if topology is not None:
             dims = {n: topology.get_dim(n) for n in topology.get_hybrid_group_names()}
             dp_degree = dims.get("data", 1)
             pp_degree = dims.get("pipe", 1)
             sharding_degree = dims.get("sharding", 1)
             sep_degree = dims.get("sep", 1)
+            ep_degree = dims.get("expert", 1)
             mp_degree = dims.get("model", 1)
         import jax
 
         n_dev = len(jax.devices())
-        prod = dp_degree * mp_degree * pp_degree * sharding_degree * sep_degree
+        prod = dp_degree * mp_degree * pp_degree * sharding_degree * sep_degree * ep_degree
         if prod != n_dev and dp_degree == 1:
             # reference behavior: leftover devices go to data parallel
-            dp_degree = n_dev // max(mp_degree * pp_degree * sharding_degree * sep_degree, 1)
+            dp_degree = n_dev // max(mp_degree * pp_degree * sharding_degree * sep_degree * ep_degree, 1)
         self._dp_degree = dp_degree
         self._mp_degree = mp_degree
         self._pp_degree = pp_degree
         self._sharding_degree = sharding_degree
         self._sep_degree = sep_degree
-        _mesh.build_mesh(dp=dp_degree, mp=mp_degree, pp=pp_degree, sharding=sharding_degree, sep=sep_degree)
+        self._ep_degree = ep_degree
+        _mesh.build_mesh(dp=dp_degree, mp=mp_degree, pp=pp_degree, sharding=sharding_degree, sep=sep_degree, ep=ep_degree)
         self._dp_group = Group(axis_name="dp")
         self._mp_group = Group(axis_name="mp")
         self._pp_group = Group(axis_name="pp")
         self._sharding_group = Group(axis_name="sharding")
         self._sep_group = Group(axis_name="sep")
+        self._ep_group = Group(axis_name="ep")
 
     # degrees
     def get_data_parallel_world_size(self):
@@ -84,6 +88,9 @@ class HybridCommunicateGroup:
 
     def get_sep_parallel_world_size(self):
         return self._sep_degree
+
+    def get_expert_parallel_world_size(self):
+        return self._ep_degree
 
     # ranks — single-controller: rank of this process along each axis is 0;
     # per-device ranks materialize inside compiled SPMD programs
@@ -102,6 +109,9 @@ class HybridCommunicateGroup:
     def get_sep_parallel_rank(self):
         return 0
 
+    def get_expert_parallel_rank(self):
+        return 0
+
     # groups
     def get_data_parallel_group(self):
         return self._dp_group
@@ -114,6 +124,9 @@ class HybridCommunicateGroup:
 
     def get_sharding_parallel_group(self):
         return self._sharding_group
+
+    def get_expert_parallel_group(self):
+        return self._ep_group
 
     def get_sep_parallel_group(self):
         return self._sep_group

@@ -14,18 +14,18 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXIS_ORDER = ("pp", "dp", "sharding", "sep", "mp")
+AXIS_ORDER = ("pp", "dp", "sharding", "sep", "ep", "mp")
 
 _global_mesh = None
 
 
-def build_mesh(dp=1, mp=1, pp=1, sharding=1, sep=1, devices=None):
+def build_mesh(dp=1, mp=1, pp=1, sharding=1, sep=1, ep=1, devices=None):
     """Create + install the global mesh; degrees must multiply to #devices
     (degree -1 on dp = absorb remaining devices)."""
     global _global_mesh
     devs = list(devices) if devices is not None else list(jax.devices())
     n = len(devs)
-    degrees = {"pp": pp, "dp": dp, "sharding": sharding, "sep": sep, "mp": mp}
+    degrees = {"pp": pp, "dp": dp, "sharding": sharding, "sep": sep, "ep": ep, "mp": mp}
     known = 1
     wild = None
     for k, v in degrees.items():

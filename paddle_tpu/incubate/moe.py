@@ -2,16 +2,27 @@
 python/paddle/incubate/distributed/models/moe/MoELayer — gshard/switch
 gating, capacity, alltoall dispatch — SURVEY.md §2.2 "EP").
 
-TPU-native: GShard-style dense dispatch (one_hot einsums — MXU-friendly,
-static shapes) with the expert dimension sharded over the 'ep'/'mp' mesh
-axis; XLA lowers the dispatch/combine einsums to all-to-alls across experts
-when sharded.  Aux load-balancing loss follows Switch/GShard.
+TPU-native:
+- gating is fully vectorized (lax.top_k + one-hot/cumsum capacity
+  assignment; the k rounds are a tiny static unroll, not a per-token loop)
+- dense path: GShard one-hot dispatch/combine einsums (MXU-friendly,
+  static shapes), expert dim sharded over 'ep' (or 'mp' when no ep axis)
+- expert-parallel path (axis_size('ep') > 1): shard_map over the 'ep'
+  axis with EXPLICIT lax.all_to_all token exchange — each device gates its
+  local tokens, exchanges [E, C_local, D] slots so it holds its E/ep
+  experts' tokens from every peer, runs its local experts, and all-to-alls
+  back (the reference's alltoall dispatch on the MoE process group).
+  Per-device capacity is per-GROUP capacity, exactly the reference's
+  local-group semantics.
 """
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from .. import nn
@@ -20,6 +31,44 @@ from ..nn import initializer as I
 from ..ops.dispatch import apply, coerce
 from ..distributed import mesh as _mesh
 from ..tensor import Tensor
+
+
+def gate_dispatch_tensors(lg, k, capacity):
+    """From router logits [T, E] build (dispatch [T, E, C], combine
+    [T, E, C], aux_loss).  Pure jax; shared by the dense path and the
+    per-shard EP path.  Vectorized: lax.top_k picks the k experts at once;
+    the static k-round unroll only sequences capacity priority (round 0
+    tokens claim slots before round 1), matching GShard."""
+    tokens, e = lg.shape
+    probs = jax.nn.softmax(lg.astype(jnp.float32), -1)  # [T, E]
+    # aux load-balance loss (GShard eq.): E * sum(me * ce)
+    me = probs.mean(0)
+    ce = jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=jnp.float32).mean(0)
+    aux = (me * ce).sum() * e
+
+    topv, topi = lax.top_k(probs, k)  # [T, k] each
+    sel = jax.nn.one_hot(topi, e, dtype=jnp.int32)  # [T, k, E]
+    disp = jnp.zeros((tokens, e, capacity), jnp.float32)
+    comb = jnp.zeros((tokens, e, capacity), jnp.float32)
+    used = jnp.zeros((e,), jnp.int32)
+    gates_accum = jnp.zeros((tokens,), jnp.float32)
+    for r in range(k):
+        s = sel[:, r]  # [T, E]
+        pos = jnp.cumsum(s, 0) * s - s + used[None, :] * s
+        slot = (pos * s).sum(-1)  # [T]
+        fits = slot < capacity
+        onehot_slot = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
+        contrib = (
+            s.astype(jnp.float32)[:, :, None]
+            * onehot_slot[:, None, :]
+            * fits.astype(jnp.float32)[:, None, None]
+        )
+        disp = disp + contrib
+        comb = comb + contrib * topv[:, r][:, None, None]
+        used = used + (s * fits[:, None].astype(jnp.int32)).sum(0)
+        gates_accum = gates_accum + topv[:, r] * fits.astype(jnp.float32)
+    comb = comb / jnp.maximum(gates_accum, 1e-9)[:, None, None]
+    return disp, comb, aux
 
 
 class TopKGate(nn.Layer):
@@ -32,57 +81,24 @@ class TopKGate(nn.Layer):
         self.capacity_factor = capacity_factor
         self.wg = nn.Linear(d_model, num_experts, bias_attr=False)
 
+    def capacity(self, tokens):
+        return max(int(self.capacity_factor * tokens * self.top_k / self.num_experts), 1)
+
     def forward(self, x):
         # returns (dispatch [tokens, E, C], combine [tokens, E, C], aux_loss)
         logits = self.wg(x)
-        e = self.num_experts
+        cap = self.capacity(int(x.shape[0]))
         k = self.top_k
-        cf = self.capacity_factor
 
         def f(lg):
-            tokens = lg.shape[0]
-            capacity = max(int(cf * tokens * k / e), 1)
-            probs = jax.nn.softmax(lg.astype(jnp.float32), -1)  # [T, E]
-            # aux load-balance loss (GShard eq.): E * sum(me * ce)
-            me = probs.mean(0)
-            top1 = jnp.argmax(probs, -1)
-            ce = jax.nn.one_hot(top1, e, dtype=jnp.float32).mean(0)
-            aux = (me * ce).sum() * e
+            return gate_dispatch_tensors(lg, k, cap)
 
-            disp = jnp.zeros((tokens, e, capacity), jnp.float32)
-            comb = jnp.zeros((tokens, e, capacity), jnp.float32)
-            remaining = probs
-            used = jnp.zeros((e,), jnp.int32)
-            gates_accum = jnp.zeros((tokens,), jnp.float32)
-            for _ in range(k):
-                idx = jnp.argmax(remaining, -1)  # [T]
-                gate = jnp.take_along_axis(remaining, idx[:, None], 1)[:, 0]
-                sel = jax.nn.one_hot(idx, e, dtype=jnp.int32)  # [T, E]
-                pos = jnp.cumsum(sel, 0) * sel - sel + used[None, :] * sel  # [T, E]
-                slot = (pos * sel).sum(-1)  # [T]
-                fits = slot < capacity
-                onehot_slot = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
-                contrib = (
-                    sel.astype(jnp.float32)[:, :, None]
-                    * onehot_slot[:, None, :]
-                    * fits.astype(jnp.float32)[:, None, None]
-                )
-                disp = disp + contrib
-                comb = comb + contrib * gate[:, None, None]
-                used = used + (sel * fits[:, None].astype(jnp.int32)).sum(0)
-                remaining = remaining * (1.0 - sel.astype(jnp.float32))
-                gates_accum = gates_accum + gate * fits.astype(jnp.float32)
-            # normalize combine weights over selected experts
-            denom = jnp.maximum(gates_accum, 1e-9)
-            comb = comb / denom[:, None, None]
-            return disp, comb, aux
-
-        disp, comb, aux = apply(f, [coerce(logits)], multi=True, name="moe_gate")
-        return disp, comb, aux
+        return apply(f, [coerce(logits)], multi=True, name="moe_gate")
 
 
 class ExpertFFN(nn.Layer):
-    """E experts' FFN weights as stacked tensors, expert dim shardable."""
+    """E experts' FFN weights as stacked tensors, expert dim sharded over
+    'ep' when the mesh provides it (falling back to 'mp')."""
 
     def __init__(self, num_experts, d_model, d_hidden, activation="gelu"):
         super().__init__()
@@ -91,11 +107,10 @@ class ExpertFFN(nn.Layer):
         self.w2 = self.create_parameter([num_experts, d_hidden, d_model], default_initializer=I.XavierNormal())
         self.b2 = self.create_parameter([num_experts, 1, d_model], is_bias=True)
         self.activation = activation
-        if _mesh.axis_size("mp") > 1:
-            _mesh.shard_tensor_(self.w1, P("mp", None, None))
-            _mesh.shard_tensor_(self.b1, P("mp", None, None))
-            _mesh.shard_tensor_(self.w2, P("mp", None, None))
-            _mesh.shard_tensor_(self.b2, P("mp", None, None))
+        axis = _expert_axis()
+        if axis is not None:
+            for t in (self.w1, self.b1, self.w2, self.b2):
+                _mesh.shard_tensor_(t, P(axis, None, None))
 
     def forward(self, x):
         """x: [E, C, d_model] → [E, C, d_model]; batched per-expert matmul."""
@@ -103,11 +118,22 @@ class ExpertFFN(nn.Layer):
         act = jax.nn.gelu if self.activation == "gelu" else jax.nn.relu
 
         def f(a, w1, b1, w2, b2):
-            h = jnp.einsum("ecd,edh->ech", a, w1) + b1
-            h = act(h)
-            return jnp.einsum("ech,ehd->ecd", h, w2) + b2
+            return _expert_ffn_arrays(a, w1, b1, w2, b2, act)
 
         return apply(f, ins, name="expert_ffn")
+
+
+def _expert_ffn_arrays(a, w1, b1, w2, b2, act):
+    h = act(jnp.einsum("ecd,edh->ech", a, w1) + b1)
+    return jnp.einsum("ech,ehd->ecd", h, w2) + b2
+
+
+def _expert_axis():
+    if _mesh.axis_size("ep") > 1:
+        return "ep"
+    if _mesh.axis_size("mp") > 1:
+        return "mp"
+    return None
 
 
 class MoELayer(nn.Layer):
@@ -125,6 +151,10 @@ class MoELayer(nn.Layer):
     def forward(self, x):
         b, s, d = x.shape[0], x.shape[1], x.shape[2]
         flat = x.reshape([b * s, d])
+        if _mesh.axis_size("ep") > 1:
+            out, aux = self._ep_forward(flat)
+            self.aux_loss = aux
+            return out.reshape([b, s, d])
         disp, comb, aux = self.gate(flat)
         self.aux_loss = aux
         ins = [coerce(flat), coerce(disp)]
@@ -133,8 +163,9 @@ class MoELayer(nn.Layer):
             return jnp.einsum("td,tec->ecd", a, dsp.astype(a.dtype))
 
         expert_in = apply(dispatch, ins, name="moe_dispatch")
-        spec = P("mp", None, None) if _mesh.axis_size("mp") > 1 else None
-        if spec is not None:
+        axis = _expert_axis()
+        if axis is not None:
+            spec = P(axis, None, None)
             expert_in = apply(lambda a: _mesh.constraint(a, spec), [expert_in], name="moe_ep_shard")
         expert_out = self.experts(expert_in)
 
@@ -143,3 +174,62 @@ class MoELayer(nn.Layer):
 
         out = apply(combine, [coerce(expert_out), coerce(comb)], name="moe_combine")
         return out.reshape([b, s, d])
+
+    def _ep_forward(self, flat):
+        """shard_map over 'ep': local gating → all_to_all dispatch → local
+        experts → all_to_all combine.  Tokens are ep-sharded on entry; the
+        expert count must divide by ep."""
+        from jax.experimental.shard_map import shard_map
+
+        mesh = _mesh.get_mesh()
+        ep = mesh.shape["ep"]
+        e = self.num_experts
+        if e % ep != 0:
+            raise ValueError(f"num_experts {e} must divide by ep degree {ep}")
+        tokens = int(flat.shape[0])
+        if tokens % ep != 0:
+            raise ValueError(f"token count {tokens} must divide by ep degree {ep}")
+        cap_local = self.gate.capacity(tokens // ep)
+        k = self.gate.top_k
+        act = jax.nn.gelu if self.experts.activation == "gelu" else jax.nn.relu
+
+        @functools.partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(
+                P("ep", None),            # tokens
+                P(None, None),            # gate weight (replicated)
+                P("ep", None, None),      # expert stacks sharded on ep
+                P("ep", None, None),
+                P("ep", None, None),
+                P("ep", None, None),
+            ),
+            out_specs=(P("ep", None), P()),
+            check_rep=False,
+        )
+        def local(fl, wg, w1, b1, w2, b2):
+            lg = fl.astype(jnp.float32) @ wg.astype(jnp.float32)  # [T_l, E]
+            disp, comb, aux = gate_dispatch_tensors(lg, k, cap_local)
+            ein = jnp.einsum("td,tec->ecd", fl, disp.astype(fl.dtype))  # [E, C_l, D]
+            # exchange: split experts across peers, gather their token slots
+            ein = lax.all_to_all(ein, "ep", split_axis=0, concat_axis=1, tiled=True)
+            # [E/ep, ep*C_l, D] — this device's experts, everyone's tokens
+            h = _expert_ffn_arrays(ein, w1, b1, w2, b2, act)
+            h = lax.all_to_all(h, "ep", split_axis=1, concat_axis=0, tiled=True)
+            out = jnp.einsum("ecd,tec->td", h, comb.astype(h.dtype))  # [T_l, D]
+            aux = lax.pmean(aux, "ep")
+            return out, aux
+
+        xp = self.experts
+
+        def f(fl, wg, w1, b1, w2, b2):
+            fl = _mesh.constraint(fl, P("ep", None))
+            return local(fl, wg, w1, b1, w2, b2)
+
+        out, aux = apply(
+            f,
+            [coerce(flat), self.gate.wg.weight, xp.w1, xp.b1, xp.w2, xp.b2],
+            multi=True,
+            name="moe_ep_a2a",
+        )
+        return out, aux

@@ -50,6 +50,12 @@ class GPTConfig:
     hidden_dropout_prob: float = 0.0
     tensor_parallel_degree: int = 1
     use_recompute: bool = False
+    # MoE (reference: incubate MoELayer wired into the decoder MLP slot);
+    # >1 turns the MLP of every other layer into a mixture of experts
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    moe_aux_coeff: float = 0.01
 
     @staticmethod
     def gpt3_13b(**overrides):
@@ -114,13 +120,24 @@ class GPTMLP(nn.Layer):
 
 
 class GPTDecoderLayer(nn.Layer):
-    def __init__(self, config):
+    def __init__(self, config, use_moe=False):
         super().__init__()
         self.config = config
         self.ln_1 = nn.LayerNorm(config.hidden_size, config.layer_norm_epsilon)
         self.attn = GPTAttention(config)
         self.ln_2 = nn.LayerNorm(config.hidden_size, config.layer_norm_epsilon)
-        self.mlp = GPTMLP(config)
+        if use_moe:
+            from ..incubate.moe import MoELayer
+
+            self.mlp = MoELayer(
+                config.hidden_size,
+                config.intermediate_size,
+                num_experts=config.moe_num_experts,
+                top_k=config.moe_top_k,
+                capacity_factor=config.moe_capacity_factor,
+            )
+        else:
+            self.mlp = GPTMLP(config)
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
 
     def _block(self, x):
@@ -157,7 +174,14 @@ class GPTModel(nn.Layer):
         super().__init__()
         self.config = config
         self.embeddings = GPTEmbeddings(config)
-        self.h = nn.LayerList([GPTDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        moe = config.moe_num_experts > 1
+        self.h = nn.LayerList(
+            [
+                # every other layer is MoE (standard GShard/Switch layout)
+                GPTDecoderLayer(config, use_moe=moe and i % 2 == 1)
+                for i in range(config.num_hidden_layers)
+            ]
+        )
         self.ln_f = nn.LayerNorm(config.hidden_size, config.layer_norm_epsilon)
 
     def forward(self, input_ids):
@@ -186,6 +210,16 @@ class GPTForCausalLM(nn.Layer):
         logits = self.lm_head(hidden)
         if labels is not None:
             loss = sequence_ce(self, logits, labels)
+            aux = [
+                layer.mlp.aux_loss
+                for layer in self.gpt.h
+                if getattr(layer.mlp, "aux_loss", None) is not None
+            ]
+            if aux:
+                total_aux = aux[0]
+                for a in aux[1:]:
+                    total_aux = total_aux + a
+                loss = loss + self.config.moe_aux_coeff * total_aux
             return loss, logits
         return logits
 
@@ -261,6 +295,11 @@ class GPTStackedDecoder(nn.Layer):
 
     def __init__(self, config, num_virtual=1):
         super().__init__()
+        if config.moe_num_experts > 1:
+            raise NotImplementedError(
+                "MoE decoder layers are not supported on the stacked SPMD "
+                "pipeline path (moe_num_experts > 1); use GPTForCausalLM"
+            )
         self.config = config
         self.num_virtual = num_virtual
         L, h, inter = (
@@ -322,8 +361,13 @@ class GPTStackedDecoder(nn.Layer):
         its eager impl path rejects specs that leave auto axes out."""
         cache = self.__dict__.setdefault("_pipe_cache", {})
         # the Mesh object itself is the key component (hashable; holding it
-        # strongly also prevents id-reuse aliasing after build_mesh())
-        key = (n_micro, remat, _mesh.get_mesh())
+        # strongly also prevents id-reuse aliasing after build_mesh()).
+        # Entries for dead meshes are evicted so repeated build_mesh() calls
+        # don't accumulate stale compiled executables (advisor r3 finding).
+        live = _mesh.get_mesh()
+        for k in [k for k in cache if k[2] is not live]:
+            del cache[k]
+        key = (n_micro, remat, live)
         fn = cache.get(key)
         if fn is None:
             cfg = self.config

@@ -1555,9 +1555,11 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=Tr
 
         if mode == "nearest":
             # half-away-from-zero like the reference kernel's ::round (jnp
-            # rounds half to even, which picks a different pixel at exact
-            # half positions)
-            rnd = lambda t: jnp.floor(t + 0.5).astype(jnp.int32)
+            # rounds half to even, and floor(t+0.5) is half-UP, which picks
+            # pixel 0 instead of -1 at negative half positions)
+            rnd = lambda t: jnp.where(
+                t >= 0, jnp.floor(t + 0.5), jnp.ceil(t - 0.5)
+            ).astype(jnp.int32)
             out = fetch(rnd(fx), rnd(fy))
         else:
             x0 = jnp.floor(fx).astype(jnp.int32)

@@ -738,17 +738,29 @@ def flash_attn_varlen(query, key, value, cu_seqlens_q, cu_seqlens_k=None, causal
     cu = coerce(cu_seqlens_q)
     if cu_seqlens_k is not None and cu_seqlens_k is not cu_seqlens_q:
         cu_k = coerce(cu_seqlens_k)
-        same = (
-            cu_k._raw.shape == cu._raw.shape
-            and not isinstance(cu._raw, jax.core.Tracer)
-            and not isinstance(cu_k._raw, jax.core.Tracer)
-            and bool((cu_k._raw == cu._raw).all())
+        traced = isinstance(cu._raw, jax.core.Tracer) or isinstance(
+            cu_k._raw, jax.core.Tracer
         )
-        if not same:
+        if traced:
+            # values can't be compared under tracing, and trusting a shape
+            # match would silently mis-compute cross-attention layouts —
+            # require the SAME object (or omit cu_seqlens_k) inside traced
+            # code; only self-attention layouts are supported either way
             raise NotImplementedError(
-                "flash_attn_varlen: distinct cu_seqlens_k is not supported "
-                "(self-attention layouts only); pass equal cu_seqlens"
+                "flash_attn_varlen: cu_seqlens_k equality cannot be "
+                "verified under @to_static tracing; pass cu_seqlens_k as "
+                "the same tensor object as cu_seqlens_q (or omit it) — "
+                "only self-attention layouts are supported"
             )
+        else:
+            same = cu_k._raw.shape == cu._raw.shape and bool(
+                (cu_k._raw == cu._raw).all()
+            )
+            if not same:
+                raise NotImplementedError(
+                    "flash_attn_varlen: distinct cu_seqlens_k is not supported "
+                    "(self-attention layouts only); pass equal cu_seqlens"
+                )
 
     def f(q, k, v, cq):
         return flash_attn_varlen_array(q, k, v, cq, causal, scale)

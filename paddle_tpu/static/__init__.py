@@ -10,6 +10,8 @@ function imperatively and runs it through an Executor.
 from __future__ import annotations
 
 import numpy as np
+import jax
+import jax.numpy as jnp
 
 from .. import framework
 from ..jit import InputSpec  # noqa: F401
@@ -167,7 +169,120 @@ def gradients(targets, inputs, target_gradients=None):
     return _grad(targets, inputs, grad_outputs=target_gradients, retain_graph=True, allow_unused=True)
 
 
+# dict-aware tensor tree walkers shared with the jit tracer
+from ..jit import _flatten_structure as _tree_tensors  # noqa: E402
+from ..jit import _rebuild_structure as _tree_restore_jit  # noqa: E402
+
+
+def _tree_restore(tpl, leaves):
+    return _tree_restore_jit(tpl, leaves)
+
+
 class nn:
+    """Static-graph control flow (reference: paddle.static.nn.cond /
+    while_loop, the ops paddle.jit dy2static lowers `if`/`while` on tensor
+    values into — python/paddle/static/nn/control_flow.py).
+
+    TPU-native lowering:
+    - cond: with a concrete predicate (dygraph) only the taken branch runs;
+      under @to_static tracing BOTH branches are traced and the outputs
+      selected elementwise (XLA `select`) — fully differentiable through the
+      tape, so branches must be side-effect-free (the reference imposes the
+      same purity on cond blocks).
+    - while_loop: lax.while_loop over explicit loop_vars.  XLA's
+      while-loop is forward-only; outputs carry stop_gradient=True.
+    """
+
+    @staticmethod
+    def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+        import numpy as _np
+
+        from ..framework import core as _core
+        from ..ops.dispatch import apply, coerce
+        from ..tensor import Tensor
+
+        pred = coerce(pred)
+        concrete = not isinstance(pred._data, jax.core.Tracer)
+        if concrete:
+            taken = bool(_np.asarray(pred._data))
+            fn = true_fn if taken else false_fn
+            return fn() if fn is not None else None
+
+        t_out = true_fn() if true_fn is not None else None
+        f_out = false_fn() if false_fn is not None else None
+        t_leaves, f_leaves = [], []
+        t_tpl = _tree_tensors(t_out, t_leaves)
+        f_tpl = _tree_tensors(f_out, f_leaves)
+        if t_tpl != f_tpl or len(t_leaves) != len(f_leaves):
+            raise ValueError(
+                "paddle.static.nn.cond: true_fn and false_fn must return "
+                "the same structure of tensors (got {} vs {})".format(t_tpl, f_tpl)
+            )
+        selected = []
+        for tt, ft in zip(t_leaves, f_leaves):
+            if tuple(tt.shape) != tuple(ft.shape):
+                raise ValueError(
+                    "paddle.static.nn.cond: branch outputs must have equal "
+                    "shapes, got {} vs {}".format(tt.shape, ft.shape)
+                )
+            selected.append(
+                apply(
+                    lambda p, a, b: jnp.where(p, a, b),
+                    [pred, tt, ft],
+                    name="cond_select",
+                )
+            )
+        return _tree_restore(t_tpl, selected)
+
+    @staticmethod
+    def while_loop(cond, body, loop_vars, is_test=False, name=None):
+        from ..framework import core as _core
+        from ..ops.dispatch import apply, coerce
+        from ..tensor import Tensor
+
+        loop_vars = list(loop_vars)
+        leaves = []
+        tpl = _tree_tensors(loop_vars, leaves)
+        leaves = [coerce(t) for t in leaves]
+
+        def f(*arrays):
+            def wrap_vals(vals):
+                ts = []
+                for a in vals:
+                    t = Tensor.__new__(Tensor)
+                    t._init_from_array(a, stop_gradient=True)
+                    ts.append(t)
+                return _tree_restore(tpl, ts)
+
+            def jcond(vals):
+                with _core.no_grad_ctx():
+                    r = cond(*wrap_vals(list(vals)))
+                r = coerce(r[0] if isinstance(r, (list, tuple)) else r)
+                return r._data.reshape(())
+
+            def jbody(vals):
+                with _core.no_grad_ctx():
+                    out = body(*wrap_vals(list(vals)))
+                sink = []
+                out_tpl = _tree_tensors(list(out), sink)
+                if out_tpl != tpl:
+                    raise ValueError(
+                        "paddle.static.nn.while_loop: body must return "
+                        "loop_vars-shaped outputs"
+                    )
+                return tuple(t._data for t in sink)
+
+            return jax.lax.while_loop(jcond, jbody, tuple(arrays))
+
+        outs = apply(
+            f,
+            leaves,
+            name="while_loop",
+            multi=True,
+            outputs_stop_gradient=[True] * len(leaves),
+        )
+        return list(_tree_restore(tpl, list(outs)))
+
     @staticmethod
     def fc(x, size, **kwargs):
         raise NotImplementedError("static fluid layers are superseded by paddle_tpu.nn")

@@ -325,17 +325,32 @@ class Tensor:
             raise TypeError("len() of a 0-D tensor")
         return self.shape[0]
 
+    def _concretize(self, caster, kind):
+        tr = _core.active_trace()
+        if tr is not None or isinstance(self._raw, jax.core.Tracer):
+            raise TypeError(
+                "A tensor's value was used as a Python {} inside a "
+                "@to_static function.  The traced program runs once with "
+                "abstract values, so data-dependent Python control flow "
+                "(`if tensor:` / `while tensor:`) cannot be captured "
+                "(reference contract: paddle.jit dy2static rewrites these "
+                "to graph ops).  Use paddle.static.nn.cond / "
+                "paddle.static.nn.while_loop for tensor-dependent branching, "
+                "or hoist the condition out of the compiled step.".format(kind)
+            )
+        return caster(self.numpy())
+
     def __bool__(self):
-        return bool(self.numpy())
+        return self._concretize(bool, "bool")
 
     def __int__(self):
-        return int(self.numpy())
+        return self._concretize(int, "int")
 
     def __float__(self):
-        return float(self.numpy())
+        return self._concretize(float, "float")
 
     def __index__(self):
-        return int(self.numpy())
+        return self._concretize(int, "index")
 
     def __hash__(self):
         return id(self)

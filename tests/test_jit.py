@@ -152,3 +152,72 @@ class TestToStatic:
 
         with pytest.raises(Exception):
             f(t(np.ones(2)))
+
+
+class TestControlFlow:
+    """dy2static contract (SURVEY §2.3 paddle.jit): tensor-dependent Python
+    branching raises an actionable error; paddle.static.nn.cond/while_loop
+    lower to XLA select / lax.while_loop."""
+
+    def test_tensor_bool_inside_trace_raises_actionable(self):
+        @paddle.jit.to_static
+        def f(x):
+            if x.sum() > 0:
+                return x + 1
+            return x - 1
+
+        with pytest.raises(TypeError, match="paddle.static.nn.cond"):
+            f(t(np.ones(3, np.float32)))
+
+    def test_cond_eager_takes_one_branch(self):
+        import paddle_tpu.static as static
+
+        calls = []
+
+        def true_fn():
+            calls.append("t")
+            return t(np.float32(1.0))
+
+        def false_fn():
+            calls.append("f")
+            return t(np.float32(2.0))
+
+        r = static.nn.cond(t(np.array(False)), true_fn, false_fn)
+        assert float(r.numpy()) == 2.0
+        assert calls == ["f"]  # dygraph: only the taken branch runs
+
+    def test_cond_compiled_differentiable_both_ways(self):
+        import paddle_tpu.static as static
+
+        w = t(np.array([2.0], np.float32))
+        w.stop_gradient = False
+
+        @paddle.jit.to_static
+        def model(x):
+            y = (x * w).sum()
+            out = static.nn.cond(y > 0, lambda: y * 3.0, lambda: y * 5.0)
+            out.backward()
+            return out
+
+        out = model(t(np.array([1.0], np.float32)))
+        assert float(out.numpy()) == 6.0
+        np.testing.assert_allclose(w.grad.numpy(), [3.0])
+        w.clear_gradient()
+        out = model(t(np.array([-1.0], np.float32)))  # same executable
+        assert float(out.numpy()) == -10.0
+        np.testing.assert_allclose(w.grad.numpy(), [-5.0])
+
+    def test_while_loop_compiled_and_eager(self):
+        import paddle_tpu.static as static
+
+        @paddle.jit.to_static
+        def loop_model(x):
+            i = t(np.int32(0))
+            _, acc = static.nn.while_loop(
+                lambda i, a: i < 5, lambda i, a: [i + 1, a * 2.0], [i, x]
+            )
+            return acc
+
+        assert float(loop_model(t(np.float32(1.0))).numpy()) == 32.0
+        out = static.nn.while_loop(lambda i: i < 3, lambda i: [i + 1], [t(np.int32(0))])
+        assert int(out[0].numpy()) == 3

@@ -202,6 +202,18 @@ def _topo_order(roots):
     return order
 
 
+# callables invoked after every completed backward pass (weakly keyed by
+# owner so a dropped DataParallel wrapper unregisters itself) — the dygraph
+# Reducer uses this to finalize gradient synchronization without requiring
+# an explicit apply_collective_grads() call (reference: reducer.cc syncs
+# during backward automatically)
+_post_backward_hooks = weakref.WeakKeyDictionary()
+
+
+def register_post_backward_hook(owner, fn):
+    _post_backward_hooks[owner] = fn
+
+
 def run_backward(
     outputs,
     out_grads=None,
@@ -310,4 +322,6 @@ def run_backward(
             out[tid] = _wrap(g) if not isinstance(g, Tensor) else g
             if not create_graph:
                 out[tid].stop_gradient = True
+    for cb in list(_post_backward_hooks.values()):
+        cb()
     return out

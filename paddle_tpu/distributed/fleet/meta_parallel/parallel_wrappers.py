@@ -3,11 +3,14 @@ python/paddle/distributed/fleet/meta_parallel/{data_parallel,*}.py +
 paddle.DataParallel in python/paddle/fluid/dygraph/parallel.py).
 
 TPU-native DP: inputs arrive batch-sharded over the 'dp' mesh axis
-(DistributedBatchSampler → device_put with P('dp', ...)); gradients come out
-correctly reduced because the loss reduction spans the global batch under
-GSPMD — no Reducer/bucketing machinery is needed (the reference's
-reducer.cc exists to overlap NCCL with backward; XLA's latency-hiding
-scheduler owns that here)."""
+(DistributedBatchSampler → device_put with P('dp', ...)).  In the
+single-controller execution model, gradients come out correctly reduced
+because the loss reduction spans the global batch under GSPMD, so the
+dygraph Reducer (reducer.py — bucketed allreduce with backward-hook
+overlap, the reference reducer.cc contract) short-circuits; on
+multi-process deployments, where per-rank grads genuinely differ outside
+compiled steps, it runs unconditionally and finalizes automatically at the
+end of each backward."""
 
 from __future__ import annotations
 
@@ -46,6 +49,17 @@ class DataParallel(_Wrapper):
         super().__init__(layers)
         if _mesh.get_mesh() is None and len(jax.devices()) > 1:
             _mesh.build_mesh(dp=-1)
+        from .reducer import Reducer
+
+        # eager (dygraph) gradient sync path: bucketed allreduce with
+        # backward-hook overlap (reference collective/reducer.cc); compiled
+        # steps never reach it — GSPMD reduces grads inside the program
+        self._reducer = Reducer(
+            list(layers.parameters()),
+            group=group,
+            bucket_cap_mb=comm_buffer_size,
+            find_unused_parameters=find_unused_parameters,
+        )
 
     def _shard_input(self, t):
         if not isinstance(t, Tensor) or _mesh.get_mesh() is None:
@@ -84,15 +98,21 @@ class DataParallel(_Wrapper):
         return loss
 
     def apply_collective_grads(self):
-        pass
+        self._reducer._on_backward_done()
 
-    @staticmethod
-    def no_sync():
+    def no_sync(self):
         import contextlib
+
+        reducer = self._reducer
 
         @contextlib.contextmanager
         def _ctx():
-            yield
+            prev = reducer._enabled
+            reducer.set_enabled(False)
+            try:
+                yield
+            finally:
+                reducer.set_enabled(prev)  # reentrancy-safe restore
 
         return _ctx()
 

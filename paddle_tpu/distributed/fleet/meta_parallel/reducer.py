@@ -1,0 +1,165 @@
+"""Eager-mode bucketed gradient synchronization for dygraph DataParallel
+(reference: paddle/fluid/distributed/collective/reducer.cc — SURVEY.md §2.2
+"Reducer (DP)").
+
+The reference overlaps NCCL allreduce with backward by hooking gradient
+accumulation and flushing fixed-size buckets.  Here the same structure runs
+over the single-controller encoding: parameters are bucketed in reverse
+construction order (gradients arrive roughly reverse-forward), a grad hook
+marks readiness with an O(1) per-bucket counter, and buckets flush in
+order as soon as a LATER bucket starts receiving gradients (by which point
+their members' contributions are fully accumulated) — one fused
+(concat-flat) all_reduce AVG per bucket, dispatched asynchronously so the
+exchange overlaps the remainder of backward.
+
+Multiply-used parameters may receive further contributions after their
+bucket flushed; such buckets are marked dirty and re-reduced in
+finalize() — AVG is linear, so re-averaging (already-averaged + new local
+contribution) yields exactly the global average.
+
+The hooks are inert inside @to_static traced backward (tracer grads):
+compiled steps get their gradient reduction from GSPMD inside the program.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from ....framework import core as _core
+from ....tensor import Tensor
+from ... import collective as _collective
+
+
+class Reducer:
+    def __init__(self, parameters, group=None, bucket_cap_mb=25, find_unused_parameters=False):
+        self._params = [p for p in parameters if not p.stop_gradient]
+        self._group = group
+        self._find_unused = find_unused_parameters
+        self._enabled = True
+
+        # bucket assignment: reverse order, capped by bytes
+        cap = int(bucket_cap_mb * 1024 * 1024)
+        self._buckets = []
+        cur, cur_bytes = [], 0
+        for p in reversed(self._params):
+            nbytes = int(np.prod(p.shape or [1])) * p.element_size()
+            cur.append(p)
+            cur_bytes += nbytes
+            if cur_bytes >= cap:
+                self._buckets.append(cur)
+                cur, cur_bytes = [], 0
+        if cur:
+            self._buckets.append(cur)
+        self._bucket_of = {}
+        for bi, b in enumerate(self._buckets):
+            for p in b:
+                self._bucket_of[id(p)] = bi
+        # single-controller short circuit: with one process, eager grads from
+        # a global-batch loss are already globally reduced (GSPMD semantics),
+        # so the AVG allreduce is the identity — skip the bucket machinery on
+        # the hot path.  Tests set _force_sync to exercise it anyway; real
+        # multi-process deployments take it unconditionally.
+        self._force_sync = False
+        self._reset_state()
+        for p in self._params:
+            p.register_hook(self._make_hook(p))
+        # finalize automatically at the end of every backward pass (the
+        # reference Reducer syncs during backward with no explicit call)
+        from ....autograd.engine import register_post_backward_hook
+
+        register_post_backward_hook(self, self._on_backward_done)
+
+    def _sync_needed(self):
+        import jax
+
+        return self._force_sync or jax.process_count() > 1
+
+    def _on_backward_done(self):
+        if self._enabled and self._sync_needed():
+            self.finalize()
+        else:
+            self._reset_state()
+
+    def _reset_state(self):
+        self._ready = set()
+        self._remaining = [len(b) for b in self._buckets]
+        self._synced = [False] * len(self._buckets)
+        self._next_unflushed = 0
+
+    def _make_hook(self, p):
+        pid = id(p)
+
+        def hook(grad):
+            raw = grad._data if isinstance(grad, Tensor) else grad
+            if (
+                not self._enabled
+                or not self._sync_needed()
+                or _core.active_trace() is not None
+                or isinstance(raw, jax.core.Tracer)
+            ):
+                return grad  # compiled steps: GSPMD reduces inside the program
+            bi = self._bucket_of.get(pid)
+            if bi is None:
+                return grad
+            if pid not in self._ready:
+                self._ready.add(pid)
+                self._remaining[bi] -= 1
+            elif self._synced[bi]:
+                # extra contribution after the bucket already flushed
+                # (multiply-used parameter): needs a re-reduce at finalize
+                self._synced[bi] = False
+            # in-order overlap flush: buckets strictly BEFORE this one have
+            # fully-accumulated grads once a later bucket starts arriving
+            while (
+                self._next_unflushed < bi
+                and self._remaining[self._next_unflushed] == 0
+            ):
+                j = self._next_unflushed
+                if not self._synced[j]:
+                    self._flush(self._buckets[j])
+                    self._synced[j] = True
+                self._next_unflushed += 1
+            return grad
+
+        return hook
+
+    def _flush(self, bucket):
+        pairs = [(p, p.grad) for p in bucket if p._grad_raw is not None]
+        if not pairs:
+            return
+        if not self._force_sync:
+            raw = pairs[0][0]._grad_raw
+            if isinstance(raw, jax.Array) and not raw.is_fully_addressable:
+                # multi-host GLOBAL array: the gradient is already globally
+                # consistent by construction (loss spans the global
+                # dp-sharded batch) — an extra allreduce is both redundant
+                # and unrunnable eagerly on non-addressable shards.  The
+                # bucket path is for process-LOCAL gradient arrays.
+                return
+        from ....ops.manipulation import concat, reshape, split
+
+        if len(pairs) == 1:
+            p, g = pairs[0]
+            _collective.all_reduce(g, op=_collective.ReduceOp.AVG, group=self._group)
+            p._grad_raw = g._raw  # write back through the property wrapper
+            return
+        grads = [g for _, g in pairs]
+        flat = concat([reshape(g, [-1]) for g in grads], axis=0)
+        _collective.all_reduce(flat, op=_collective.ReduceOp.AVG, group=self._group)
+        sizes = [int(np.prod(g.shape or [1])) for g in grads]
+        pieces = split(flat, sizes, axis=0)
+        for (p, g), piece in zip(pairs, pieces):
+            p._grad_raw = reshape(piece, list(g.shape))._raw
+
+    def finalize(self):
+        """Synchronize every bucket not already flushed — or flushed but
+        dirtied by a post-flush contribution (reference:
+        Reducer::FinalizeBackward); called from apply_collective_grads."""
+        for bi, bucket in enumerate(self._buckets):
+            if not self._synced[bi]:
+                self._flush(bucket)
+        self._reset_state()
+
+    def set_enabled(self, flag):
+        self._enabled = bool(flag)

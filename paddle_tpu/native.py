@@ -18,6 +18,8 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _CSRC = os.path.join(_ROOT, "csrc")
 _BUILD = os.path.join(_CSRC, "build")
 _LIBNAME = "libpaddle_tpu_core.so"
+# installed wheel layout: the .so is bundled inside the package dir
+_PKG_LIB = os.path.join(os.path.dirname(os.path.abspath(__file__)), _LIBNAME)
 
 _lib = None
 _tried = False
@@ -51,7 +53,7 @@ def get_lib():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        path = os.path.join(_BUILD, _LIBNAME)
+        path = _PKG_LIB if os.path.exists(_PKG_LIB) else os.path.join(_BUILD, _LIBNAME)
         if not os.path.exists(path):
             path = _try_build()
         if not path:

@@ -20,6 +20,149 @@ def _is_inexact(arr):
     return jnp.issubdtype(jnp.dtype(arr.dtype), jnp.inexact)
 
 
+# ---------------------------------------------------------------------------
+# Eager fast path: cached jitted forward(+VJP) executables.
+#
+# SURVEY §7 "hard parts": per-op dispatch overhead.  A fresh `jax.vjp`
+# retrace per eager op costs ~ms of Python; here each (op code, closure
+# values, input avals) maps to ONE jitted executable returning
+# (outs, vjp_fn) — jax.vjp's vjp_fn is a pytree (Partial over residual
+# arrays), so it crosses the jit boundary, and one shared jitted applier
+# runs it at backward time.  Ops whose closures capture arrays/Tensors (or
+# anything we can't hash by value) skip the cache and take the retrace
+# path.  This mirrors the reference's cached ad_funcs + KernelFactory
+# lookup (paddle/fluid/eager/api/generated) in spirit: dispatch becomes a
+# dictionary hit.
+# ---------------------------------------------------------------------------
+
+import collections as _collections
+
+_UNHASHABLE = object()
+_SIMPLE_TYPES = (int, float, bool, str, bytes, type(None))
+_EAGER_CACHE = _collections.OrderedDict()
+_EAGER_CACHE_CAP = 1024
+_BWD_APPLY = None
+
+
+def _freeze(v, depth=0):
+    """Value -> hashable key component, or _UNHASHABLE."""
+    if depth > 6:
+        return _UNHASHABLE
+    if isinstance(v, _SIMPLE_TYPES):
+        # type matters: 1 == 1.0 == True hash equal, but bake into an
+        # executable differently (dtype promotion)
+        return (type(v).__name__, v)
+    from ..tensor import Tensor
+
+    if isinstance(v, (Tensor, jax.Array)) or type(v).__module__ == "numpy":
+        return _UNHASHABLE  # mutable-by-rebind / array values: never key
+    if isinstance(v, (tuple, list)):
+        items = tuple(_freeze(x, depth + 1) for x in v)
+        if any(i is _UNHASHABLE for i in items):
+            return _UNHASHABLE
+        return (type(v).__name__, items)
+    if isinstance(v, dict):
+        try:
+            keys = sorted(v)
+        except TypeError:
+            return _UNHASHABLE
+        items = tuple((k, _freeze(v[k], depth + 1)) for k in keys)
+        if any(i[1] is _UNHASHABLE for i in items):
+            return _UNHASHABLE
+        return ("dict", items)
+    if callable(v):
+        return _code_key(v, depth + 1)
+    try:
+        hash(v)
+    except TypeError:
+        return _UNHASHABLE
+    return (type(v).__name__, v)
+
+
+import types as _types
+
+# callables without __code__ that are safe to key by identity: these kinds
+# have no user-mutable behavioral state (a custom __call__ object does, so
+# it must NOT be identity-keyed — its attributes can change between calls)
+_IDENTITY_CALLABLES = (
+    _types.BuiltinFunctionType,
+    _types.MethodWrapperType,
+)
+
+
+def _identity_keyable(fn):
+    if isinstance(fn, _IDENTITY_CALLABLES):
+        return True
+    mod = type(fn).__module__ or ""
+    # numpy ufuncs and jax's custom_jvp/custom_vjp wrappers around
+    # module-level functions (jax.nn.relu etc.)
+    return mod.startswith("numpy") or mod.startswith("jax.")
+
+
+def _code_key(fn, depth=0):
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        if not _identity_keyable(fn):
+            return _UNHASHABLE
+        try:
+            hash(fn)
+        except TypeError:
+            return _UNHASHABLE
+        return ("obj", fn)  # held strongly by the key, so identity is stable
+    parts = []
+    for c in fn.__closure__ or ():
+        fr = _freeze(c.cell_contents, depth + 1)
+        if fr is _UNHASHABLE:
+            return _UNHASHABLE
+        parts.append(fr)
+    # default args are op config as much as closures are
+    for d in (fn.__defaults__ or ()) + tuple(sorted((fn.__kwdefaults__ or {}).items())):
+        fr = _freeze(d, depth + 1)
+        if fr is _UNHASHABLE:
+            return _UNHASHABLE
+        parts.append(fr)
+    return (code, tuple(parts))
+
+
+_last_salt_mesh = None
+
+
+def _dispatch_salt():
+    """Global state an op's lowering may read without it being an input.
+    A mesh change clears the whole cache — entries keyed on a dead mesh
+    could never hit again and would strand compiled executables (same
+    staleness class as the GPT pipe-cache advisor finding)."""
+    global _last_salt_mesh
+    from ..distributed import mesh as _mesh
+
+    mesh = _mesh.get_mesh()
+    if mesh is not _last_salt_mesh:
+        _EAGER_CACHE.clear()
+        _last_salt_mesh = mesh
+    amp = _core.active_amp()
+    amp_key = (amp.enabled, amp.level, amp.dtype) if amp is not None else None
+    return (mesh, amp_key, _core.flag("FLAGS_check_nan_inf"))
+
+
+def _cache_get(key, builder):
+    entry = _EAGER_CACHE.get(key)
+    if entry is None:
+        entry = builder()
+        _EAGER_CACHE[key] = entry
+        if len(_EAGER_CACHE) > _EAGER_CACHE_CAP:
+            _EAGER_CACHE.popitem(last=False)
+    else:
+        _EAGER_CACHE.move_to_end(key)
+    return entry
+
+
+def _bwd_apply():
+    global _BWD_APPLY
+    if _BWD_APPLY is None:
+        _BWD_APPLY = jax.jit(lambda vf, cts: vf(cts))
+    return _BWD_APPLY
+
+
 def wrap(arr, stop_gradient=True):
     from ..tensor import Tensor
 
@@ -65,8 +208,22 @@ def apply(fn, inputs, name=None, multi=False, outputs_stop_gradient=None):
         (not t.stop_gradient) and _is_inexact(a) for t, a in zip(inputs, arrays)
     )
 
+    # eager fast path eligibility: concrete arrays, no active trace, and a
+    # closure we can key by value
+    eager = _core.active_trace() is None and not any(
+        isinstance(a, jax.core.Tracer) for a in arrays
+    )
+    ckey = _code_key(fn) if eager else _UNHASHABLE
+    if ckey is not _UNHASHABLE:
+        avals = tuple((tuple(a.shape), jnp.dtype(a.dtype)) for a in arrays)
+        ckey = (ckey, avals, multi, _dispatch_salt())
+
     if not record:
-        out = fn(*arrays)
+        if ckey is not _UNHASHABLE:
+            jfn = _cache_get(("fwd", ckey), lambda: jax.jit(lambda *ar: fn(*ar)))
+            out = jfn(*arrays)
+        else:
+            out = fn(*arrays)
         outs = out if multi else (out,)
         tensors = tuple(wrap(o) for o in outs)
         if outputs_stop_gradient is not None:
@@ -90,7 +247,35 @@ def apply(fn, inputs, name=None, multi=False, outputs_stop_gradient=None):
         return r if multi else (r,)
 
     primals = [arrays[i] for i in diff_idx]
-    outs, vjp_fn = jax.vjp(f, *primals)
+    if ckey is not _UNHASHABLE:
+        vkey = ("vjp", ckey, tuple(diff_idx))
+        nd_idx = [i for i in range(len(arrays)) if i not in diff_idx]
+
+        def build():
+            # fn from THIS call is baked in; the key guarantees any later
+            # hit has byte-identical code and closure values
+            captured_fn = fn
+
+            def fwd(diff, nondiff):
+                def g(*d):
+                    buf = [None] * (len(diff_idx) + len(nd_idx))
+                    for i, a in zip(diff_idx, d):
+                        buf[i] = a
+                    for i, a in zip(nd_idx, nondiff):
+                        buf[i] = a
+                    r = captured_fn(*buf)
+                    return r if multi else (r,)
+
+                return jax.vjp(g, *diff)
+
+            return jax.jit(fwd)
+
+        fwd_jit = _cache_get(vkey, build)
+        outs, raw_vjp = fwd_jit(tuple(primals), tuple(arrays[i] for i in nd_idx))
+        bwd = _bwd_apply()
+        vjp_fn = lambda cts, _vf=raw_vjp: bwd(_vf, cts)  # noqa: E731
+    else:
+        outs, vjp_fn = jax.vjp(f, *primals)
 
     tensors = tuple(
         wrap(o, stop_gradient=not _is_inexact(o)) for o in outs

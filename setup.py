@@ -1,0 +1,58 @@
+"""Wheel build for paddle-tpu (reference: setup.py / python/setup.py.in —
+SURVEY.md §2.4 "setup.py / wheel": the wheel bundles the native core).
+
+The C++ runtime (csrc/) is built with CMake+Ninja during `build_py` and the
+resulting libpaddle_tpu_core.so is copied into the package so the installed
+tree loads it without a source checkout (paddle_tpu/native.py checks the
+package dir first).  If no native toolchain is available the wheel still
+builds — native.py degrades to its pure-Python path.
+"""
+
+import os
+import shutil
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+from setuptools.dist import Distribution
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+CSRC = os.path.join(ROOT, "csrc")
+BUILD = os.path.join(CSRC, "build")
+LIB = "libpaddle_tpu_core.so"
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        self._build_native()
+        super().run()
+        built = os.path.join(BUILD, LIB)
+        if os.path.exists(built):
+            dest_pkg = os.path.join(self.build_lib, "paddle_tpu")
+            os.makedirs(dest_pkg, exist_ok=True)
+            shutil.copy2(built, os.path.join(dest_pkg, LIB))
+
+    @staticmethod
+    def _build_native():
+        if not os.path.isdir(CSRC):
+            return
+        try:
+            subprocess.run(
+                ["cmake", "-B", BUILD, "-G", "Ninja", "-DCMAKE_BUILD_TYPE=Release"],
+                cwd=CSRC, check=True,
+            )
+            subprocess.run(["ninja", "-C", BUILD, "paddle_tpu_core"], check=True)
+        except (OSError, subprocess.CalledProcessError) as e:
+            print(f"WARNING: native core build skipped ({e}); "
+                  "wheel ships the pure-Python fallback")
+
+
+class BinaryDistribution(Distribution):
+    """The wheel bundles a platform .so — tag it platform-specific so pip
+    never installs a Linux build onto a foreign OS/arch."""
+
+    def has_ext_modules(self):
+        return True
+
+
+setup(cmdclass={"build_py": BuildWithNative}, distclass=BinaryDistribution)

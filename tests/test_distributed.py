@@ -114,6 +114,53 @@ class TestDataParallel:
         out = dp(x)
         assert out.shape == [16, 2]
 
+    def test_dp_dygraph_reducer_parity(self):
+        # pure-eager (no @to_static) DP training through the bucketed
+        # Reducer must match single-device training step for step
+        # (reference contract: collective/reducer.cc dygraph path)
+        from paddle_tpu.vision.models import LeNet
+
+        rng = np.random.RandomState(0)
+        xs = [rng.rand(16, 1, 28, 28).astype(np.float32) for _ in range(3)]
+        ys = [rng.randint(0, 10, (16,)).astype(np.int64) for _ in range(3)]
+
+        def train(use_dp):
+            paddle.seed(0)
+            model = LeNet()
+            if use_dp:
+                pmesh.build_mesh(dp=8)
+                model = paddle.DataParallel(model, comm_buffer_size=1)
+                # force the bucket machinery (single-controller mode would
+                # short-circuit the identity allreduce on the hot path)
+                model._reducer._force_sync = True
+            opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=model.parameters())
+            ce = paddle.nn.CrossEntropyLoss()
+            losses = []
+            for x, y in zip(xs, ys):
+                loss = ce(model(t(x)), t(y))
+                loss.backward()
+                if use_dp:
+                    model.apply_collective_grads()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss.numpy()))
+            pmesh.set_mesh(None)
+            return losses
+
+        ref = train(False)
+        dp = train(True)
+        np.testing.assert_allclose(dp, ref, rtol=1e-5, atol=1e-5)
+
+    def test_dp_no_sync_context(self):
+        pmesh.build_mesh(dp=8)
+        model = paddle.DataParallel(nn.Linear(4, 2))
+        x = t(np.random.rand(8, 4).astype(np.float32))
+        with model.no_sync():
+            assert not model._reducer._enabled
+            model(x).sum().backward()
+        assert model._reducer._enabled  # re-enabled after the context
+        model.apply_collective_grads()  # manual sync still works
+
     def test_dp_training_step_compiled(self):
         pmesh.build_mesh(dp=8)
         paddle.seed(0)
@@ -288,6 +335,36 @@ class TestDistributedCheckpoint:
         load_state_dict({"w": w2}, str(tmp_path / "ckpt"))
         np.testing.assert_allclose(w2.numpy(), orig, rtol=1e-6)
         assert w2._raw.sharding.shard_shape(w2._raw.shape) == (1, 16)
+        # multi-host-honest restore: orbax got ArrayRestoreArgs with the
+        # target sharding (each host reads only its shards) — not a full
+        # numpy round trip
+        assert load_state_dict.last_restore_mode == "sharded-orbax"
+
+    def test_restore_is_born_sharded(self, tmp_path, monkeypatch):
+        """The orbax restore must deliver arrays already in the target
+        sharding; jax.device_put on a full host array must NOT run for
+        Tensor entries (the round-3 'every host reads every byte' finding)."""
+        from paddle_tpu.distributed import checkpoint as ckpt
+
+        pmesh.build_mesh(sharding=8)
+        w = t(np.random.rand(16, 4))
+        pmesh.shard_tensor_(w, P("sharding", None))
+        orig = w.numpy().copy()
+        ckpt.save_state_dict({"w": w}, str(tmp_path / "ckpt"))
+
+        calls = []
+        real_put = ckpt.jax.device_put
+        monkeypatch.setattr(
+            ckpt.jax, "device_put", lambda *a, **k: calls.append(a) or real_put(*a, **k)
+        )
+        w._data = w._data * 0
+        ckpt.load_state_dict({"w": w}, str(tmp_path / "ckpt"))
+        np.testing.assert_allclose(w.numpy(), orig, rtol=1e-6)
+        # orbax itself places shard-sized chunks (8 puts of [2,4] here);
+        # what must NOT appear is a full-array [16,4] put — that would mean
+        # the loader materialized the whole tensor on host first
+        full = [a for a in calls if getattr(a[0], "shape", None) == (16, 4)]
+        assert full == [], "restore fell back to full-array device_put"
 
     def test_async_save_then_load(self, tmp_path):
         from paddle_tpu.distributed.checkpoint import (

@@ -294,3 +294,52 @@ class TestInplace:
         q.add_(1.0)
         (q * q).backward()
         assert float(w.grad.numpy()) == pytest.approx(42.0)
+
+
+class TestEagerDispatchCache:
+    """The eager fast path caches jitted fwd(+VJP) executables keyed by
+    (op code, closure values, input avals) — same-shaped calls with
+    different closure config must NOT collide."""
+
+    def test_closure_values_distinguish_entries(self):
+        import paddle_tpu.nn.functional as F
+
+        x = t(np.random.RandomState(0).rand(1, 3, 8, 8).astype(np.float32))
+        w = t(np.random.RandomState(1).rand(4, 3, 3, 3).astype(np.float32))
+        s1 = F.conv2d(x, w, stride=1, padding=1).numpy()
+        s2 = F.conv2d(x, w, stride=2, padding=1).numpy()
+        assert s1.shape != s2.shape  # stride lives in the closure, not avals
+        # repeat: cache hits must reproduce, not cross-serve
+        np.testing.assert_array_equal(F.conv2d(x, w, stride=1, padding=1).numpy(), s1)
+        np.testing.assert_array_equal(F.conv2d(x, w, stride=2, padding=1).numpy(), s2)
+
+    def test_cached_vjp_matches_fresh(self):
+        from paddle_tpu.ops import dispatch
+
+        def run():
+            a = t(np.random.RandomState(2).rand(4, 5).astype(np.float32), rg=True)
+            b = t(np.random.RandomState(3).rand(5, 6).astype(np.float32), rg=True)
+            out = paddle.matmul(a, b)
+            out.sum().backward()
+            return out.numpy(), a.grad.numpy(), b.grad.numpy()
+
+        o1, ga1, gb1 = run()
+        o2, ga2, gb2 = run()  # second call: cached executable path
+        np.testing.assert_allclose(o1, o2)
+        np.testing.assert_allclose(ga1, ga2)
+        np.testing.assert_allclose(gb1, gb2)
+
+        saved = dispatch._code_key
+        dispatch._code_key = lambda fn, depth=0: dispatch._UNHASHABLE
+        try:
+            o3, ga3, gb3 = run()  # uncached retrace path
+        finally:
+            dispatch._code_key = saved
+        np.testing.assert_allclose(o1, o3, rtol=1e-6)
+        np.testing.assert_allclose(ga1, ga3, rtol=1e-6)
+        np.testing.assert_allclose(gb1, gb3, rtol=1e-6)
+
+    def test_cache_capped(self):
+        from paddle_tpu.ops import dispatch
+
+        assert len(dispatch._EAGER_CACHE) <= dispatch._EAGER_CACHE_CAP

@@ -43,6 +43,7 @@ from . import metric
 from . import device
 from . import profiler
 from . import incubate
+from . import sparse
 from . import static
 from . import inference
 from .framework.io import save, load  # noqa: F401

@@ -62,13 +62,30 @@ class Reducer:
         # multi-process deployments take it unconditionally.
         self._force_sync = False
         self._reset_state()
+        import weakref
+
+        wr = weakref.ref(self)
         for p in self._params:
-            p.register_hook(self._make_hook(p))
+            p.register_hook(self._weak_hook(wr, id(p)))
         # finalize automatically at the end of every backward pass (the
         # reference Reducer syncs during backward with no explicit call)
         from ....autograd.engine import register_post_backward_hook
 
         register_post_backward_hook(self, self._on_backward_done)
+
+    @staticmethod
+    def _weak_hook(wr, pid):
+        """Grad hook holding the Reducer WEAKLY: params outlive the
+        DataParallel wrapper, so a strong closure would keep every Reducer
+        ever constructed alive (and stack their syncs on re-wrap)."""
+
+        def hook(grad):
+            self = wr()
+            if self is None:
+                return grad
+            return self._hook_impl(pid, grad)
+
+        return hook
 
     def _sync_needed(self):
         import jax
@@ -87,42 +104,43 @@ class Reducer:
         self._synced = [False] * len(self._buckets)
         self._next_unflushed = 0
 
-    def _make_hook(self, p):
-        pid = id(p)
-
-        def hook(grad):
-            raw = grad._data if isinstance(grad, Tensor) else grad
-            if (
-                not self._enabled
-                or not self._sync_needed()
-                or _core.active_trace() is not None
-                or isinstance(raw, jax.core.Tracer)
-            ):
-                return grad  # compiled steps: GSPMD reduces inside the program
-            bi = self._bucket_of.get(pid)
-            if bi is None:
-                return grad
-            if pid not in self._ready:
-                self._ready.add(pid)
-                self._remaining[bi] -= 1
-            elif self._synced[bi]:
-                # extra contribution after the bucket already flushed
-                # (multiply-used parameter): needs a re-reduce at finalize
-                self._synced[bi] = False
-            # in-order overlap flush: buckets strictly BEFORE this one have
-            # fully-accumulated grads once a later bucket starts arriving
-            while (
-                self._next_unflushed < bi
-                and self._remaining[self._next_unflushed] == 0
-            ):
-                j = self._next_unflushed
-                if not self._synced[j]:
-                    self._flush(self._buckets[j])
-                    self._synced[j] = True
-                self._next_unflushed += 1
+    def _hook_impl(self, pid, grad):
+        raw = grad._data if isinstance(grad, Tensor) else grad
+        if (
+            not self._enabled
+            or not self._sync_needed()
+            or _core.active_trace() is not None
+            or isinstance(raw, jax.core.Tracer)
+        ):
+            return grad  # compiled steps: GSPMD reduces inside the program
+        bi = self._bucket_of.get(pid)
+        if bi is None:
             return grad
-
-        return hook
+        if pid not in self._ready:
+            self._ready.add(pid)
+            self._remaining[bi] -= 1
+        elif self._synced[bi]:
+            # extra contribution after the bucket already flushed
+            # (multiply-used parameter): needs a re-reduce at finalize
+            self._synced[bi] = False
+        if self._find_unused:
+            # a never-used param would stall the in-order flush below at its
+            # bucket forever; with the flag set, defer everything to the
+            # post-backward finalize (correct, no overlap) — the reference
+            # instead walks the autograd graph up front to mark unused
+            return grad
+        # in-order overlap flush: buckets strictly BEFORE this one have
+        # fully-accumulated grads once a later bucket starts arriving
+        while (
+            self._next_unflushed < bi
+            and self._remaining[self._next_unflushed] == 0
+        ):
+            j = self._next_unflushed
+            if not self._synced[j]:
+                self._flush(self._buckets[j])
+                self._synced[j] = True
+            self._next_unflushed += 1
+        return grad
 
     def _flush(self, bucket):
         pairs = [(p, p.grad) for p in bucket if p._grad_raw is not None]
@@ -137,16 +155,23 @@ class Reducer:
                 # and unrunnable eagerly on non-addressable shards.  The
                 # bucket path is for process-LOCAL gradient arrays.
                 return
+        import jax.numpy as jnp
+
         from ....ops.manipulation import concat, reshape, split
 
-        if len(pairs) == 1:
-            p, g = pairs[0]
-            _collective.all_reduce(g, op=_collective.ReduceOp.AVG, group=self._group)
-            p._grad_raw = g._raw  # write back through the property wrapper
-            return
         grads = [g for _, g in pairs]
         flat = concat([reshape(g, [-1]) for g in grads], axis=0)
-        _collective.all_reduce(flat, op=_collective.ReduceOp.AVG, group=self._group)
+        if jax.process_count() > 1:
+            # process-local grads on a multi-process job: the fused bucket
+            # crosses hosts via the coordination-backed allgather (one
+            # global computation over all processes), then averages —
+            # the eager axis-less collective cannot span processes
+            from jax.experimental import multihost_utils
+
+            stacked = multihost_utils.process_allgather(flat._raw)
+            flat._data = jnp.mean(stacked, axis=0)
+        else:
+            _collective.all_reduce(flat, op=_collective.ReduceOp.AVG, group=self._group)
         sizes = [int(np.prod(g.shape or [1])) for g in grads]
         pieces = split(flat, sizes, axis=0)
         for (p, g), piece in zip(pairs, pieces):

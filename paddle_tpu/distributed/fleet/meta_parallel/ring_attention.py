@@ -172,9 +172,11 @@ def _ring_attention_pallas_local(q, k, v, axis_name, causal, scale):
             dk_acc = dk_acc + dk_h.astype(jnp.float32)
             dv_acc = dv_acc + dv_h.astype(jnp.float32)
             # dk/dv ride WITH their kv blocks; after R rotations total they
-            # arrive back at the owner device
-            kcur = jax.lax.ppermute(kcur, axis_name, perm)
-            vcur = jax.lax.ppermute(vcur, axis_name, perm)
+            # arrive back at the owner device.  kcur/vcur are dead after
+            # the final hop — only the accumulators still need to travel.
+            if hop < R - 1:
+                kcur = jax.lax.ppermute(kcur, axis_name, perm)
+                vcur = jax.lax.ppermute(vcur, axis_name, perm)
             dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
             dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
         return (

@@ -2,6 +2,7 @@
 fused-op wrappers."""
 
 from . import recompute as _recompute_mod  # noqa: F401
+from . import fp8  # noqa: F401
 from .recompute import recompute  # noqa: F401
 
 

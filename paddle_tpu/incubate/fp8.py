@@ -1,0 +1,100 @@
+"""FP8 training primitives (reference: paddle.incubate fp8 / Transformer
+Engine-style delayed scaling — SURVEY.md §2.3 `paddle.incubate`).
+
+TPU-native: jnp.float8_e4m3fn (forward operands) and float8_e5m2
+(gradients) with per-tensor scaling.  On chips without an fp8 MXU path the
+dot upcasts to bf16 — numerics (the fp8 quantization grid) are identical,
+so models trained here transfer to fp8-native hardware; storage and HBM
+traffic get the 2x fp8 saving either way.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.dispatch import apply, coerce
+
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+
+
+def _amax_to_scale(amax, fmax):
+    return jnp.where(amax > 0, fmax / amax, 1.0).astype(jnp.float32)
+
+
+def quantize_fp8(x, dtype="e4m3", scale=None):
+    """Quantize to fp8 with a per-tensor scale.  Returns (x_fp8, scale)
+    where `x ≈ x_fp8.astype(f32) / scale`."""
+    x = coerce(x)
+    fmax = E4M3_MAX if dtype == "e4m3" else E5M2_MAX
+    jdt = jnp.float8_e4m3fn if dtype == "e4m3" else jnp.float8_e5m2
+    ins = [x] + ([coerce(scale)] if scale is not None else [])
+
+    def f(a, *s):
+        a32 = a.astype(jnp.float32)
+        sc = s[0].astype(jnp.float32) if s else _amax_to_scale(jnp.max(jnp.abs(a32)), fmax)
+        q = jnp.clip(a32 * sc, -fmax, fmax).astype(jdt)
+        return q, sc
+
+    return apply(f, ins, multi=True, name="quantize_fp8")
+
+
+def dequantize_fp8(x_fp8, scale, dtype="float32"):
+    x_fp8, scale = coerce(x_fp8), coerce(scale)
+    from ..framework import core as _core
+
+    jdt = _core.to_jax_dtype(dtype)
+    return apply(lambda q, s: (q.astype(jnp.float32) / s).astype(jdt), [x_fp8, scale], name="dequantize_fp8")
+
+
+def fp8_matmul(x, w, x_scale=None, w_scale=None, out_dtype="bfloat16"):
+    """y = x @ w computed through the fp8 quantization grid: both operands
+    round to e4m3 (with per-tensor scales) before the dot.  Gradient flows
+    straight-through (the standard fp8-training estimator)."""
+    x, w = coerce(x), coerce(w)
+    from ..framework import core as _core
+
+    jdt = _core.to_jax_dtype(out_dtype)
+
+    def f(a, b):
+        @jax.custom_vjp
+        def _mm(a, b):
+            a32 = a.astype(jnp.float32)
+            b32 = b.astype(jnp.float32)
+            sa = _amax_to_scale(jnp.max(jnp.abs(a32)), E4M3_MAX)
+            sb = _amax_to_scale(jnp.max(jnp.abs(b32)), E4M3_MAX)
+            qa = jnp.clip(a32 * sa, -E4M3_MAX, E4M3_MAX).astype(jnp.float8_e4m3fn)
+            qb = jnp.clip(b32 * sb, -E4M3_MAX, E4M3_MAX).astype(jnp.float8_e4m3fn)
+            y = jnp.matmul(
+                qa.astype(jnp.bfloat16), qb.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+            return (y / (sa * sb)).astype(jdt)
+
+        def fwd(a, b):
+            return _mm(a, b), (a, b)
+
+        def bwd(res, g):
+            a, b = res
+            # e5m2 gradients (wider range, the fp8-training convention)
+            g32 = g.astype(jnp.float32)
+            sg = _amax_to_scale(jnp.max(jnp.abs(g32)), E5M2_MAX)
+            qg = jnp.clip(g32 * sg, -E5M2_MAX, E5M2_MAX).astype(jnp.float8_e5m2)
+            gq = qg.astype(jnp.float32) / sg
+            da = jnp.matmul(gq, b.astype(jnp.float32).T).astype(a.dtype)
+            db = jnp.matmul(a.astype(jnp.float32).T, gq).astype(b.dtype)
+            return da, db
+
+        _mm.defvjp(fwd, bwd)
+        return _mm(a, b)
+
+    return apply(f, [x, w], name="fp8_matmul")
+
+
+def linear_fp8(x, weight, bias=None):
+    """F.linear through the fp8 grid (reference: incubate fp8 linear)."""
+    out = fp8_matmul(x, weight)
+    if bias is not None:
+        out = out + coerce(bias)
+    return out

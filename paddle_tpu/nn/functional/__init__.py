@@ -1414,8 +1414,12 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
     return apply(f, [x], name="temporal_shift")
 
 
-def linear_fp8(*a, **k):
-    raise NotImplementedError("fp8 path lands with quantization support")
+def linear_fp8(x, weight, bias=None, name=None):
+    """Linear through the fp8 (e4m3) quantization grid with per-tensor
+    scaling — see paddle_tpu.incubate.fp8 (reference: incubate fp8)."""
+    from ...incubate.fp8 import linear_fp8 as _impl
+
+    return _impl(x, weight, bias)
 
 
 def thresholded_relu(x, threshold=1.0, value=0.0, name=None):

@@ -1,0 +1,162 @@
+"""Sparse tensors (reference: paddle.sparse — SparseCooTensor /
+SparseCsrTensor over PHI sparse kernels, paddle/phi/kernels/sparse/,
+SURVEY.md §2.1 "PHI tensor core").
+
+TPU-native: backed by jax.experimental.sparse.BCOO — the batched-COO
+format XLA can lower on TPU (gather/scatter + segment reductions on dense
+tiles), so sparse ops compose with jit/grad rather than needing custom
+CUDA kernels.  The API mirrors the reference subset that matters for
+training: construction, to_dense/to_sparse round trips, elementwise
+add/mul, relu, sparse @ dense matmul, and value transforms.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from .ops.dispatch import coerce, wrap
+from .tensor import Tensor
+
+
+class SparseCooTensor:
+    """COO sparse tensor (reference: paddle.sparse.sparse_coo_tensor).
+
+    Holds a BCOO; `.indices()` / `.values()` / `.to_dense()` follow the
+    reference API.  Dense-result ops return paddle Tensors.
+    """
+
+    def __init__(self, bcoo):
+        self._bcoo = bcoo
+
+    # -- construction -----------------------------------------------------
+    @staticmethod
+    def from_dense(x):
+        x = coerce(x)
+        return SparseCooTensor(jsparse.BCOO.fromdense(x._data))
+
+    # -- reference surface ------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        from .framework import core as _core
+
+        return _core.convert_dtype(self._bcoo.dtype)
+
+    @property
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self):
+        return wrap(jnp.transpose(self._bcoo.indices))  # [ndim, nnz] like paddle
+
+    def values(self):
+        return wrap(self._bcoo.data)
+
+    def to_dense(self):
+        return wrap(self._bcoo.todense())
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    # -- math -------------------------------------------------------------
+    def __add__(self, other):
+        if isinstance(other, SparseCooTensor):
+            return SparseCooTensor((self._bcoo + other._bcoo).sum_duplicates())
+        return wrap(self._bcoo.todense() + coerce(other)._data)
+
+    def __mul__(self, scalar):
+        return SparseCooTensor(
+            jsparse.BCOO((self._bcoo.data * scalar, self._bcoo.indices), shape=self._bcoo.shape)
+        )
+
+    def matmul(self, dense):
+        """sparse [m, k] @ dense [k, n] -> dense Tensor [m, n]."""
+        d = coerce(dense)
+        return wrap(self._bcoo @ d._data)
+
+    def transpose(self, perm=None):
+        ndim = len(self._bcoo.shape)
+        perm = perm or list(reversed(range(ndim)))
+        idx = self._bcoo.indices[:, jnp.asarray(perm)]
+        shape = tuple(self._bcoo.shape[p] for p in perm)
+        return SparseCooTensor(jsparse.BCOO((self._bcoo.data, idx), shape=shape))
+
+    def _map_values(self, fn):
+        return SparseCooTensor(
+            jsparse.BCOO((fn(self._bcoo.data), self._bcoo.indices), shape=self._bcoo.shape)
+        )
+
+    def __repr__(self):
+        return f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, dtype={self.dtype})"
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_gradient=True):
+    """Build from [ndim, nnz] indices + [nnz] values (reference signature)."""
+    idx = coerce(indices)._data.astype(jnp.int32)
+    vals = coerce(values)._data
+    if dtype is not None:
+        from .framework import core as _core
+
+        vals = vals.astype(_core.to_jax_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in np.asarray(jnp.max(idx, axis=1)))
+    return SparseCooTensor(jsparse.BCOO((vals, jnp.transpose(idx)), shape=tuple(shape)))
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    return SparseCooTensor.from_dense(x)
+
+
+def to_dense(x):
+    return x.to_dense() if isinstance(x, SparseCooTensor) else coerce(x)
+
+
+def add(a, b):
+    return a + b
+
+
+def matmul(a, b):
+    if isinstance(a, SparseCooTensor):
+        return a.matmul(b)
+    return coerce(a).matmul(coerce(b))
+
+
+def masked_matmul(x, y, mask):
+    """dense @ dense, sampled at `mask`'s sparsity pattern (reference:
+    paddle.sparse.masked_matmul — the SDDMM kernel)."""
+    x, y = coerce(x), coerce(y)
+    idx = mask._bcoo.indices  # [nnz, 2]
+    rows = x._data[idx[:, 0]]
+    cols = y._data[:, idx[:, 1]].T
+    vals = jnp.sum(rows * cols, axis=-1)
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=mask._bcoo.shape))
+
+
+class nn:
+    """paddle.sparse.nn subset."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+
+def relu(x):
+    return x._map_values(lambda v: jnp.maximum(v, 0))
+
+
+def sqrt(x):
+    return x._map_values(jnp.sqrt)
+
+
+def sin(x):
+    return x._map_values(jnp.sin)
+
+
+def tanh(x):
+    return x._map_values(jnp.tanh)

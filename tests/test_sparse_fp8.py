@@ -1,0 +1,113 @@
+"""paddle.sparse (SparseCooTensor over BCOO) and incubate fp8 tests
+(SURVEY.md §2.1 PHI sparse kernels; §2.3 paddle.incubate FP8)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sparse
+
+
+def t(arr, rg=False):
+    return paddle.to_tensor(np.asarray(arr), stop_gradient=not rg)
+
+
+class TestSparseCoo:
+    def _dense(self):
+        d = np.zeros((4, 5), np.float32)
+        d[0, 1] = 2.0
+        d[2, 3] = -1.5
+        d[3, 0] = 4.0
+        return d
+
+    def test_roundtrip(self):
+        d = self._dense()
+        s = sparse.to_sparse_coo(t(d))
+        assert s.shape == [4, 5]
+        assert s.nnz == 3
+        np.testing.assert_allclose(s.to_dense().numpy(), d)
+
+    def test_construct_from_indices_values(self):
+        idx = np.array([[0, 2, 3], [1, 3, 0]], np.int64)
+        vals = np.array([2.0, -1.5, 4.0], np.float32)
+        s = sparse.sparse_coo_tensor(t(idx), t(vals), shape=[4, 5])
+        np.testing.assert_allclose(s.to_dense().numpy(), self._dense())
+        np.testing.assert_array_equal(s.indices().numpy(), idx)
+        np.testing.assert_allclose(s.values().numpy(), vals)
+
+    def test_add_and_scale(self):
+        d = self._dense()
+        s = sparse.to_sparse_coo(t(d))
+        two = (s + s).to_dense().numpy()
+        np.testing.assert_allclose(two, 2 * d)
+        np.testing.assert_allclose((s * 3.0).to_dense().numpy(), 3 * d)
+
+    def test_spmm_matches_dense(self):
+        d = self._dense()
+        rhs = np.random.RandomState(0).rand(5, 3).astype(np.float32)
+        s = sparse.to_sparse_coo(t(d))
+        np.testing.assert_allclose(
+            sparse.matmul(s, t(rhs)).numpy(), d @ rhs, rtol=1e-5
+        )
+
+    def test_relu_transpose(self):
+        d = self._dense()
+        s = sparse.to_sparse_coo(t(d))
+        np.testing.assert_allclose(sparse.relu(s).to_dense().numpy(), np.maximum(d, 0))
+        np.testing.assert_allclose(s.transpose().to_dense().numpy(), d.T)
+
+    def test_masked_matmul(self):
+        rng = np.random.RandomState(1)
+        x = rng.rand(4, 6).astype(np.float32)
+        y = rng.rand(6, 5).astype(np.float32)
+        mask = sparse.to_sparse_coo(t(self._dense()))
+        out = sparse.masked_matmul(t(x), t(y), mask)
+        full = x @ y
+        expect = np.where(self._dense() != 0, full, 0)
+        np.testing.assert_allclose(out.to_dense().numpy(), expect, rtol=1e-5)
+
+
+class TestFP8:
+    def test_quantize_dequantize_roundtrip(self):
+        from paddle_tpu.incubate import fp8
+
+        rng = np.random.RandomState(0)
+        x = (rng.rand(32, 16).astype(np.float32) - 0.5) * 10
+        q, scale = fp8.quantize_fp8(t(x))
+        back = fp8.dequantize_fp8(q, scale).numpy()
+        # e4m3 has ~2 decimal digits; amax scaling keeps relative error small
+        assert np.abs(back - x).max() / np.abs(x).max() < 0.07
+
+    def test_fp8_matmul_close_to_fp32(self):
+        from paddle_tpu.incubate import fp8
+
+        rng = np.random.RandomState(1)
+        a = rng.rand(16, 32).astype(np.float32) - 0.5
+        b = rng.rand(32, 8).astype(np.float32) - 0.5
+        out = fp8.fp8_matmul(t(a), t(b)).astype("float32").numpy()
+        ref = a @ b
+        assert np.abs(out - ref).max() / np.abs(ref).max() < 0.12
+
+    def test_fp8_matmul_grad_flows(self):
+        from paddle_tpu.incubate import fp8
+
+        rng = np.random.RandomState(2)
+        a = t(rng.rand(8, 16).astype(np.float32) - 0.5, rg=True)
+        b = t(rng.rand(16, 4).astype(np.float32) - 0.5, rg=True)
+        out = fp8.fp8_matmul(a, b)
+        out.astype("float32").sum().backward()
+        assert a.grad is not None and b.grad is not None
+        # straight-through estimator: grads approximate the fp32 ones
+        ga_ref = np.ones((8, 4), np.float32) @ np.asarray(b.numpy()).T
+        assert np.abs(a.grad.numpy() - ga_ref).max() / np.abs(ga_ref).max() < 0.1
+
+    def test_linear_fp8_functional(self):
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.RandomState(3)
+        x = rng.rand(4, 8).astype(np.float32)
+        w = rng.rand(8, 6).astype(np.float32)
+        bias = rng.rand(6).astype(np.float32)
+        out = F.linear_fp8(t(x), t(w), t(bias)).astype("float32").numpy()
+        ref = x @ w + bias
+        assert np.abs(out - ref).max() / np.abs(ref).max() < 0.12

@@ -306,8 +306,10 @@ def bench_bert():
     n_params = sum(p.size for p in model.parameters())
 
     @paddle.jit.to_static
-    def train_step(ids, starts, ends):
-        loss, _, _ = model(ids, start_positions=starts, end_positions=ends)
+    def train_step(ids, mask, starts, ends):
+        loss, _, _ = model(
+            ids, attention_mask=mask, start_positions=starts, end_positions=ends
+        )
         loss.backward()
         opt.step()
         opt.clear_grad()
@@ -315,9 +317,14 @@ def bench_bert():
 
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seqlen)).astype(np.int32))
-    st = paddle.to_tensor(rng.randint(0, seqlen, (batch,)).astype(np.int64))
-    en = paddle.to_tensor(rng.randint(0, seqlen, (batch,)).astype(np.int64))
-    dt = _time_steps(train_step, (ids, st, en), steps)
+    # realistic SQuAD batch: variable lengths, padded to seqlen — the
+    # padding mask rides as segment ids so the Pallas kernel stays engaged
+    lens = rng.randint(seqlen // 2, seqlen + 1, (batch,))
+    mask_np = (np.arange(seqlen)[None, :] < lens[:, None]).astype(np.int64)
+    mask = paddle.to_tensor(mask_np)
+    st = paddle.to_tensor(rng.randint(0, seqlen // 2, (batch,)).astype(np.int64))
+    en = paddle.to_tensor(rng.randint(0, seqlen // 2, (batch,)).astype(np.int64))
+    dt = _time_steps(train_step, (ids, mask, st, en), steps)
     ex_s = batch * steps / dt
     mfu = 6.0 * n_params * (batch * seqlen * steps / dt) / _chip_peak_flops()
     return {

@@ -30,6 +30,7 @@ def export(layer, path, example_inputs, with_weights=True):
     """
     from jax import export as jexport
 
+    was_training = getattr(layer, "training", False)
     layer.eval()
     arrays = [
         (x._raw if isinstance(x, Tensor) else np.asarray(x)) for x in example_inputs
@@ -56,6 +57,8 @@ def export(layer, path, example_inputs, with_weights=True):
         f.write(blob)
     if with_weights:
         _save(layer.state_dict(), path + ".pdiparams")
+    if was_training:
+        layer.train()  # export must not flip the live model to eval
     return path
 
 

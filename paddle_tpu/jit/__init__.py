@@ -389,17 +389,72 @@ class InputSpec:
 
 
 def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save — persists weights; programs re-trace on load (XLA
-    executables are machine-specific, unlike the reference's ProgramDesc)."""
+    """paddle.jit.save — the reference's full inference-model export
+    (weights + program).  With `input_spec` the traced program serializes
+    to StableHLO alongside the weights (same artifact as
+    `inference.export`, loadable by `inference.Predictor`/`jit.load`);
+    without a spec only weights are saved (a warning says so — shapes are
+    needed to trace)."""
     from ..framework.io import save as _save
 
-    if hasattr(layer, "state_dict"):
-        _save(layer.state_dict(), path + ".pdparams")
+    if isinstance(layer, StaticFunction):
+        target = getattr(layer._fn, "__self__", None)
+        if not hasattr(target, "state_dict"):
+            target = None
     else:
+        target = layer
+
+    if input_spec:
+        import numpy as _np
+
+        from ..tensor import Tensor as _T
+
+        example = []
+        dynamic = any(
+            (d is None or d == -1) for spec in input_spec for d in spec.shape
+        )
+        if dynamic:
+            import logging
+
+            logging.getLogger("paddle_tpu").warning(
+                "jit.save: dynamic dims (None/-1) in input_spec are pinned "
+                "to 1 — the exported program is shape-specialized (XLA "
+                "static shapes); export one spec per shape bucket you serve"
+            )
+        for spec in input_spec:
+            shape = [1 if (d is None or d == -1) else int(d) for d in spec.shape]
+            from ..framework import core as _core2
+
+            example.append(_T(_np.zeros(shape, _core2.to_jax_dtype(spec.dtype))))
+        from ..inference import export as _export
+
+        mod = layer if hasattr(layer, "state_dict") else target
+        if mod is None:
+            raise TypeError("jit.save expects a Layer (or a bound StaticFunction)")
+        _export(mod, path, example)
+        return
+    mod = layer if hasattr(layer, "state_dict") else target
+    if mod is None:
         raise TypeError("jit.save expects a Layer")
+    import logging
+
+    logging.getLogger("paddle_tpu").warning(
+        "jit.save: no input_spec given — saving weights only; pass "
+        "input_spec=[InputSpec(shape, dtype)] to also export the program "
+        "(StableHLO), or use paddle_tpu.inference.export"
+    )
+    _save(mod.state_dict(), path + ".pdparams")
 
 
 def load(path, **configs):
+    """jit.load — a program export (<path>.stablehlo) loads as a runnable
+    Predictor; a weights-only save loads the state_dict."""
+    import os as _os
+
+    if _os.path.exists(path + ".stablehlo"):
+        from ..inference import Predictor
+
+        return Predictor(path)
     from ..framework.io import load as _load
 
     return _load(path + ".pdparams")

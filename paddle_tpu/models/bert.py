@@ -75,8 +75,10 @@ class BertEncoderLayer(nn.Layer):
         self.dropout1 = nn.Dropout(config.hidden_dropout_prob)
         self.dropout2 = nn.Dropout(config.hidden_dropout_prob)
 
-    def forward(self, x, attn_mask=None):
-        x = self.norm1(x + self.dropout1(self.attn(x, attn_mask=attn_mask)))
+    def forward(self, x, attn_mask=None, segment_ids=None):
+        x = self.norm1(
+            x + self.dropout1(self.attn(x, attn_mask=attn_mask, segment_ids=segment_ids))
+        )
         ff = self.linear2(F.gelu(self.linear1(x)))
         return self.norm2(x + self.dropout2(ff))
 
@@ -90,21 +92,27 @@ class BertModel(nn.Layer):
         self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
-        mask = None
+        segs = None
         if attention_mask is not None:
-            # [b, s] 1/0 → additive [b, 1, 1, s]
+            # [b, s] 1/0 key-padding mask → SEGMENT IDS: valid tokens share
+            # id 0, each padded position gets a unique nonzero id (attends
+            # only to itself; its row is garbage but unread).  Segment
+            # masking keeps the Pallas flash kernel eligible — an additive
+            # mask forces the XLA fallback (round-3 weak finding).
             import jax.numpy as jnp
+            from jax import lax
 
             from ..ops.dispatch import apply, coerce
 
-            mask = apply(
-                lambda m: (1.0 - m.astype(jnp.float32))[:, None, None, :] * -1e30,
-                [coerce(attention_mask)],
-                name="bert_mask",
-            )
+            def to_segs(m):
+                valid = m.astype(jnp.int32) > 0
+                pos = lax.broadcasted_iota(jnp.int32, m.shape, len(m.shape) - 1)
+                return jnp.where(valid, 0, pos + 1)
+
+            segs = apply(to_segs, [coerce(attention_mask)], name="bert_mask_segs")
         x = self.embeddings(input_ids, token_type_ids)
         for layer in self.encoder:
-            x = layer(x, mask)
+            x = layer(x, segment_ids=segs)
         pooled = F.tanh(self.pooler(x[:, 0]))
         return x, pooled
 
@@ -121,6 +129,19 @@ class BertForQuestionAnswering(nn.Layer):
         seq, _ = self.bert(input_ids, token_type_ids, attention_mask)
         logits = self.classifier(seq)
         start_logits, end_logits = ops.unbind(logits, axis=2)
+        if attention_mask is not None:
+            # padded rows carry arbitrary hidden states under the segment-id
+            # scheme — exclude their span logits from the position softmax
+            import jax.numpy as jnp
+
+            from ..ops.dispatch import apply as _apply, coerce as _coerce
+
+            def _mask_logits(lg, m):
+                return jnp.where(m.astype(jnp.int32) > 0, lg, -1e30)
+
+            am = _coerce(attention_mask)
+            start_logits = _apply(_mask_logits, [start_logits, am], name="span_mask")
+            end_logits = _apply(_mask_logits, [end_logits, am], name="span_mask")
         if start_positions is not None:
             loss = (
                 F.cross_entropy(start_logits, start_positions)

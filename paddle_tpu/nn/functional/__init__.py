@@ -1316,12 +1316,15 @@ def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e
 
 
 def scaled_dot_product_attention(
-    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True,
+    name=None, *, segment_ids=None
 ):
-    """Inputs [batch, seq, heads, head_dim] (paddle convention)."""
+    """Inputs [batch, seq, heads, head_dim] (paddle convention).
+    segment_ids: optional [batch, seq] int packed-sequence/padding masking
+    that keeps the Pallas kernel eligible (see ops/flash_attention.py)."""
     from ...ops.flash_attention import scaled_dot_product_attention as _sdpa
 
-    return _sdpa(query, key, value, attn_mask, dropout_p, is_causal, training)
+    return _sdpa(query, key, value, attn_mask, dropout_p, is_causal, training, segment_ids)
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False, name=None):

@@ -56,7 +56,7 @@ class MultiHeadAttention(Layer):
         v = ops.zeros([b, 0, self.num_heads, self.head_dim], key.dtype)
         return self.Cache(k, v)
 
-    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None, segment_ids=None):
         key = query if key is None else key
         value = query if value is None else value
         q = self._shape(self.q_proj(query))
@@ -71,7 +71,7 @@ class MultiHeadAttention(Layer):
                 cache = self.Cache(k, v)
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, dropout_p=self.dropout,
-            is_causal=False, training=self.training,
+            is_causal=False, training=self.training, segment_ids=segment_ids,
         )
         b, s = out.shape[0], out.shape[1]
         out = out.reshape([b, s, self.embed_dim])

@@ -705,8 +705,13 @@ def flash_attn_varlen_array(q, k, v, cu_seqlens, causal=True, scale=None):
 
 
 def scaled_dot_product_attention(
-    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True,
+    segment_ids=None,
 ):
+    """segment_ids: optional [b, s] int Tensor — packed-sequence / padding
+    masking that KEEPS the Pallas kernel eligible (an additive attn_mask
+    forces the XLA fallback; models with plain key-padding masks should
+    pass segment ids instead — see models/bert.py)."""
     query, key, value = coerce(query), coerce(key), coerce(value)
     ins = [query, key, value]
     has_mask = attn_mask is not None
@@ -719,9 +724,14 @@ def scaled_dot_product_attention(
                 lambda m: jnp.where(m, 0.0, _NEG_INF).astype(jnp.float32), [mask]
             )
         ins.append(mask)
+    has_segs = segment_ids is not None
+    if has_segs:
+        ins.append(coerce(segment_ids))
 
-    def f(q, k, v, *m):
-        return sdpa_array(q, k, v, m[0] if m else None, is_causal)
+    def f(q, k, v, *rest):
+        m = rest[0] if has_mask else None
+        segs = rest[-1] if has_segs else None
+        return sdpa_array(q, k, v, m, is_causal, segment_ids=segs)
 
     out = apply(f, ins, name="flash_attention")
     if dropout_p > 0.0 and training:

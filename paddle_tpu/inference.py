@@ -22,16 +22,21 @@ from .framework.io import save as _save
 from .tensor import Tensor
 
 
-def export(layer, path, example_inputs, with_weights=True):
-    """Serialize `layer.forward` traced at example_inputs to StableHLO.
+def export(layer, path, example_inputs, with_weights=True, params_from=None):
+    """Serialize `layer.forward` (or a plain callable) traced at
+    example_inputs to StableHLO.
 
     example_inputs: list of Tensors/arrays defining shapes+dtypes.
+    params_from: Layer whose state_dict to save when `layer` is a bare
+    callable (e.g. a @to_static-decorated bound method).
     Produces: <path>.stablehlo (serialized program), <path>.pdiparams.
     """
     from jax import export as jexport
 
+    weights_owner = params_from if params_from is not None else layer
     was_training = getattr(layer, "training", False)
-    layer.eval()
+    if hasattr(layer, "eval"):
+        layer.eval()
     arrays = [
         (x._raw if isinstance(x, Tensor) else np.asarray(x)) for x in example_inputs
     ]
@@ -55,8 +60,8 @@ def export(layer, path, example_inputs, with_weights=True):
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path + ".stablehlo", "wb") as f:
         f.write(blob)
-    if with_weights:
-        _save(layer.state_dict(), path + ".pdiparams")
+    if with_weights and hasattr(weights_owner, "state_dict"):
+        _save(weights_owner.state_dict(), path + ".pdiparams")
     if was_training:
         layer.train()  # export must not flip the live model to eval
     return path

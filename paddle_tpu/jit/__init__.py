@@ -428,10 +428,14 @@ def save(layer, path, input_spec=None, **configs):
             example.append(_T(_np.zeros(shape, _core2.to_jax_dtype(spec.dtype))))
         from ..inference import export as _export
 
-        mod = layer if hasattr(layer, "state_dict") else target
-        if mod is None:
+        if hasattr(layer, "state_dict"):
+            _export(layer, path, example)
+        elif isinstance(layer, StaticFunction) and target is not None:
+            # export the DECORATED function itself (not the owning Layer's
+            # forward); weights come from the bound Layer
+            _export(layer, path, example, params_from=target)
+        else:
             raise TypeError("jit.save expects a Layer (or a bound StaticFunction)")
-        _export(mod, path, example)
         return
     mod = layer if hasattr(layer, "state_dict") else target
     if mod is None:

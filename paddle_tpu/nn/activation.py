@@ -235,3 +235,31 @@ class Maxout(Layer):
 
     def forward(self, x):
         return F.maxout(x, self._groups, self._axis)
+
+
+class SiLU(Layer):
+    def forward(self, x):
+        return F.silu(x)
+
+
+class GLU(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return F.glu(x, self._axis)
+
+
+class LogSigmoid(Layer):
+    def forward(self, x):
+        return F.log_sigmoid(x)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=0.125, upper=0.3333333333333333, name=None):
+        super().__init__()
+        self._lower, self._upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self._lower, self._upper, training=self.training)

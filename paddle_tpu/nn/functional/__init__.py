@@ -1582,3 +1582,337 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=Tr
         return jnp.transpose(out, (0, 3, 1, 2))  # [N,C,Ho,Wo]
 
     return apply(f, [x, grid], name="grid_sample")
+
+
+# ---------------------------------------------------------------------------
+# round-4 API-breadth pass (§2.3 long tail): losses, 3D pools, fold, CTC
+# ---------------------------------------------------------------------------
+
+
+log_sigmoid = _unary(jax.nn.log_sigmoid, "log_sigmoid")
+
+
+def square_error_cost(input, label):
+    input, label = coerce(input), coerce(label)
+    return apply(lambda a, b: (a - b) ** 2, [input, label], name="square_error_cost")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    input, label = coerce(input), coerce(label)
+    return apply(
+        lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+        [input, label],
+        name="log_loss",
+    )
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    input, label = coerce(input), coerce(label)
+
+    def f(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        out = jnp.where(ad <= delta, 0.5 * d * d, delta * (ad - 0.5 * delta))
+        if reduction == "mean":
+            return out.mean()
+        if reduction == "sum":
+            return out.sum()
+        return out
+
+    return apply(f, [input, label], name="huber_loss")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    x, y = coerce(x), coerce(y)
+    return apply(
+        lambda a, b: jnp.sum(jnp.abs(a - b + epsilon) ** p, axis=-1, keepdims=keepdim)
+        ** (1.0 / p),
+        [x, y],
+        name="pairwise_distance",
+    )
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    input1, input2, label = coerce(input1), coerce(input2), coerce(label)
+
+    def f(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12
+        )
+        out = jnp.where(y > 0, 1 - cos, jnp.maximum(0.0, cos - margin))
+        if reduction == "mean":
+            return out.mean()
+        if reduction == "sum":
+            return out.sum()
+        return out
+
+    return apply(f, [input1, input2, label], name="cosine_embedding_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """input [N, ..., C] probabilities, label [N, ..., 1] int (reference
+    semantics: one-hot overlap over all but the batch dim)."""
+    input, label = coerce(input), coerce(label)
+
+    def f(p, y):
+        c = p.shape[-1]
+        oh = jax.nn.one_hot(y[..., 0].astype(jnp.int32), c, dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * oh, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(oh, axis=reduce_dims)
+        return (1 - (2 * inter + epsilon) / (union + epsilon)).mean()
+
+    return apply(f, [input, label], name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    anchor, positive, labels = coerce(anchor), coerce(positive), coerce(labels)
+
+    def f(a, p, y):
+        reg = l2_reg * (jnp.sum(a * a, -1).mean() + jnp.sum(p * p, -1).mean()) / 4
+        sim = a @ p.T  # [B, B]
+        same = (y[:, None] == y[None, :]).astype(jnp.float32)
+        tgt = same / jnp.maximum(same.sum(-1, keepdims=True), 1)
+        ce = -(tgt * jax.nn.log_softmax(sim, -1)).sum(-1).mean()
+        return ce + reg
+
+    return apply(f, [anchor, positive, labels], name="npair_loss")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """out[.., o] = x1 W_o x2 (+b); weight [out, in1, in2]."""
+    x1, x2, weight = coerce(x1), coerce(x2), coerce(weight)
+    ins = [x1, x2, weight]
+    if bias is not None:
+        ins.append(coerce(bias))
+
+    def f(a, b, w, *bb):
+        out = jnp.einsum("...i,oij,...j->...o", a, w, b)
+        if bb:
+            out = out + bb[0]
+        return out
+
+    return apply(f, ins, name="bilinear")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    x = coerce(x)
+    r = downscale_factor
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            return a.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        return a.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // r, w // r, c * r * r)
+
+    return apply(f, [x], name="pixel_unshuffle")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    x = coerce(x)
+    pl, pr, pt, pb = (padding, padding, padding, padding) if isinstance(padding, int) else padding
+
+    def f(a):
+        if data_format == "NCHW":
+            return jnp.pad(a, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+        return jnp.pad(a, ((0, 0), (pt, pb), (pl, pr), (0, 0)))
+
+    return apply(f, [x], name="zeropad2d")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """col2im (reference: F.fold): x [N, C*kh*kw, L] -> [N, C, H, W] with
+    overlapping windows SUMMED — expressed as a scatter-add XLA handles."""
+    x = coerce(x)
+    oh, ow = _tuplize(output_sizes, 2)
+    kh, kw = _tuplize(kernel_sizes, 2)
+    sh, sw = _tuplize(strides, 2)
+    ph, pw = _tuplize(paddings, 2)
+    dh, dw = _tuplize(dilations, 2)
+    n_h = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    n_w = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+
+    def f(a):
+        n, ckk, L = a.shape
+        c = ckk // (kh * kw)
+        cols = a.reshape(n, c, kh, kw, n_h, n_w)
+        # absolute row/col for every (kernel pos, window) pair, padded coords
+        ih = (jnp.arange(kh) * dh)[:, None] + (jnp.arange(n_h) * sh)[None, :]  # [kh, n_h]
+        iw = (jnp.arange(kw) * dw)[:, None] + (jnp.arange(n_w) * sw)[None, :]
+        out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), a.dtype)
+        flat_idx = (
+            ih[:, None, :, None] * (ow + 2 * pw) + iw[None, :, None, :]
+        ).reshape(-1)  # [kh*kw*n_h*n_w]
+        vals = cols.reshape(n, c, -1)
+        out = out.reshape(n, c, -1).at[:, :, flat_idx].add(vals)
+        out = out.reshape(n, c, oh + 2 * ph, ow + 2 * pw)
+        return out[:, :, ph : ph + oh, pw : pw + ow]
+
+    return apply(f, [x], name="fold")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    """Connectionist Temporal Classification (reference: F.ctc_loss over
+    warpctc).  TPU-native: the standard alpha recursion in log space as a
+    lax.scan over time — static shapes, batched over B.
+
+    log_probs: [T, B, C] (paddle layout), labels: [B, S] int32 padded,
+    input_lengths/label_lengths: [B]."""
+    log_probs, labels = coerce(log_probs), coerce(labels)
+    input_lengths, label_lengths = coerce(input_lengths), coerce(label_lengths)
+
+    def f(lp, lab, in_len, lab_len):
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), -1)
+        T, B, C = lp.shape
+        S = lab.shape[1]
+        # extended label sequence with interleaved blanks: length 2S+1
+        ext = jnp.full((B, 2 * S + 1), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        Lext = 2 * lab_len.astype(jnp.int32) + 1  # [B]
+        NEG = -1e30
+
+        # emission log-prob of each extended symbol at each time
+        def emit(t_lp):  # [B, C] -> [B, 2S+1]
+            return jnp.take_along_axis(t_lp, ext, axis=1)
+
+        # allowed skip: ext[s] != ext[s-2] (and s >= 2)
+        skip_ok = jnp.concatenate(
+            [jnp.zeros((B, 2), bool), ext[:, 2:] != ext[:, :-2]], axis=1
+        )
+
+        alpha0 = jnp.full((B, 2 * S + 1), NEG)
+        alpha0 = alpha0.at[:, 0].set(emit(lp[0])[:, 0])
+        alpha0 = alpha0.at[:, 1].set(jnp.where(lab_len > 0, emit(lp[0])[:, 1], NEG))
+
+        def step(alpha, t):
+            prev1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], 1)
+            prev2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], 1)
+            prev2 = jnp.where(skip_ok, prev2, NEG)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+            new = merged + emit(lp[t])
+            # freeze past each sequence's input length
+            new = jnp.where((t < in_len)[:, None], new, alpha)
+            return new, None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        idx_last = jnp.maximum(Lext - 1, 0)
+        idx_prev = jnp.maximum(Lext - 2, 0)
+        a_last = jnp.take_along_axis(alpha, idx_last[:, None], 1)[:, 0]
+        a_prev = jnp.where(
+            Lext >= 2, jnp.take_along_axis(alpha, idx_prev[:, None], 1)[:, 0], NEG
+        )
+        nll = -jnp.logaddexp(a_last, a_prev)
+        if norm_by_times:
+            nll = nll / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+        if reduction == "mean":
+            return (nll / jnp.maximum(lab_len.astype(jnp.float32), 1.0)).mean()
+        if reduction == "sum":
+            return nll.sum()
+        return nll
+
+    return apply(f, [log_probs, labels, input_lengths, label_lengths], name="ctc_loss")
+
+
+def _pool3d_spec(kernel_size, stride, padding, ndhwc):
+    k = _tuplize(kernel_size, 3)
+    s = _tuplize(stride if stride is not None else kernel_size, 3)
+    pad = _conv_padding(padding, 3, s, k, (1, 1, 1))
+    if isinstance(pad, str):
+        pad_spec = pad
+    elif ndhwc:
+        pad_spec = [(0, 0)] + list(pad) + [(0, 0)]
+    else:
+        pad_spec = [(0, 0), (0, 0)] + list(pad)
+    dims = (1,) + k + (1,) if ndhwc else (1, 1) + k
+    strides = (1,) + s + (1,) if ndhwc else (1, 1) + s
+    return k, pad_spec, dims, strides
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False, data_format="NCDHW", name=None):
+    x = coerce(x)
+    k, pad_spec, dims, strides = _pool3d_spec(kernel_size, stride, padding, data_format == "NDHWC")
+
+    def f(a):
+        init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+        return lax.reduce_window(a, init, lax.max, dims, strides, pad_spec)
+
+    out = apply(f, [x], name="max_pool3d")
+    if return_mask:
+        idx = apply(lambda a: jnp.zeros_like(a, jnp.int32), [out.detach()])
+        return out, idx
+    return out
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    x = coerce(x)
+    k, pad_spec, dims, strides = _pool3d_spec(kernel_size, stride, padding, data_format == "NDHWC")
+
+    def f(a):
+        summed = lax.reduce_window(a, 0.0, lax.add, dims, strides, pad_spec)
+        if divisor_override:
+            return summed / divisor_override
+        if exclusive and not isinstance(pad_spec, str):
+            counts = lax.reduce_window(jnp.ones_like(a), 0.0, lax.add, dims, strides, pad_spec)
+            return summed / counts
+        return summed / (k[0] * k[1] * k[2])
+
+    return apply(f, [x], name="avg_pool3d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    x = coerce(x)
+    od, oh, ow = _tuplize(output_size, 3)
+
+    def f(a):
+        n, c, d, h, w = a.shape
+        if d % od == 0 and h % oh == 0 and w % ow == 0:
+            return a.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow).mean((3, 5, 7))
+        raise NotImplementedError("adaptive_avg_pool3d needs divisible sizes")
+
+    return apply(f, [x], name="adaptive_avg_pool3d")
+
+
+def conv3d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0,
+    groups=1, dilation=1, data_format="NCDHW", output_size=None, name=None,
+):
+    """3-D transposed conv via input (lhs) dilation — the 2-D path's
+    formulation lifted to DHW.  weight: [in, out, kd, kh, kw]."""
+    if groups != 1:
+        raise NotImplementedError("conv3d_transpose: groups > 1 not supported")
+    if output_size is not None:
+        raise NotImplementedError(
+            "conv3d_transpose: output_size not supported; use output_padding"
+        )
+    x, weight = coerce(x), coerce(weight)
+    ins = [x, weight]
+    if bias is not None:
+        ins.append(coerce(bias))
+    ins = amp_cast_inputs(ins, "white")
+    strides = _tuplize(stride, 3)
+    dil = _tuplize(dilation, 3)
+    pad = _conv_padding(padding, 3, strides, None, dil)
+    op = _tuplize(output_padding, 3)
+
+    def f(a, w, *b):
+        ks = w.shape[2:]
+        if isinstance(pad, str):
+            raise NotImplementedError("conv3d_transpose: string padding unsupported")
+        pairs = [
+            (dil[i] * (ks[i] - 1) - pad[i][0], dil[i] * (ks[i] - 1) - pad[i][1] + op[i])
+            for i in range(3)
+        ]
+        w2 = jnp.transpose(jnp.flip(w, (2, 3, 4)), (1, 0, 2, 3, 4))
+        out = lax.conv_general_dilated(
+            a, w2, window_strides=(1, 1, 1), padding=pairs,
+            lhs_dilation=strides, rhs_dilation=dil,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        )
+        if b:
+            out = out + b[0].reshape([1, -1, 1, 1, 1])
+        return out
+
+    return apply(f, ins, name="conv3d_transpose")

@@ -122,3 +122,53 @@ class TripletMarginLoss(Layer):
 
     def forward(self, input, positive, negative):
         return F.triplet_margin_loss(input, positive, negative, self.margin, self.p, self.epsilon, self.swap, self.reduction)
+
+
+class HuberLoss(Layer):
+    def __init__(self, reduction="mean", delta=1.0, name=None):
+        super().__init__()
+        self.reduction, self.delta = reduction, delta
+
+    def forward(self, input, label):
+        return F.huber_loss(input, label, delta=self.delta, reduction=self.reduction)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths, norm_by_times=False):
+        return F.ctc_loss(
+            log_probs, labels, input_lengths, label_lengths,
+            blank=self.blank, reduction=self.reduction, norm_by_times=norm_by_times,
+        )
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        import jax
+
+        from ..ops.dispatch import apply, coerce
+
+        ins = [coerce(input), coerce(label)]
+        if self.weight is not None:
+            ins.append(coerce(self.weight))
+        red = self.reduction
+
+        def f(x, y, *w):
+            per = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+            if w:
+                per = per * w[0]
+            per = per.mean(-1)
+            if red == "mean":
+                return per.mean()
+            if red == "sum":
+                return per.sum()
+            return per
+
+        return apply(f, ins, name="multilabel_soft_margin")

@@ -303,3 +303,30 @@ class RNN(Layer):
             outs = outs[::-1]
         out = _ops.stack(outs, axis=axis)
         return out, states
+
+
+class RNNCellBase(Layer):
+    """Base for custom RNN cells (reference: paddle.nn.RNNCellBase)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32", init_value=0.0, batch_dim_idx=0):
+        import numpy as np
+
+        from ..ops.dispatch import coerce, wrap
+        import jax.numpy as jnp
+
+        b = coerce(batch_ref).shape[batch_dim_idx]
+        from ..framework import core as _core
+
+        if shape is None:
+            # reference contract: subclasses define state_shape
+            shape = getattr(self, "state_shape", None)
+            if shape is None:
+                hs = getattr(self, "hidden_size", None)
+                if hs is None:
+                    raise ValueError(
+                        "get_initial_states needs `shape`, or the cell must "
+                        "define `state_shape` (or `hidden_size`)"
+                    )
+                shape = [hs]
+        shp = [b] + list(shape)
+        return wrap(jnp.full(shp, init_value, _core.to_jax_dtype(dtype)))

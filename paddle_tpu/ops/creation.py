@@ -197,3 +197,26 @@ def as_complex(x, name=None):
 def as_real(x, name=None):
     x = coerce(x)
     return apply(lambda a: jnp.stack([a.real, a.imag], -1), [x], name="as_real")
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64", name=None):
+    """[2, n] lower-triangle indices (reference: paddle.tril_indices)."""
+    import jax.numpy as jnp
+
+    from ..framework import core as _core
+    from .dispatch import wrap
+
+    col = row if col is None else col
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return wrap(jnp.stack([r, c]).astype(_core.to_jax_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    import jax.numpy as jnp
+
+    from ..framework import core as _core
+    from .dispatch import wrap
+
+    col = row if col is None else col
+    r, c = jnp.triu_indices(row, k=offset, m=col)
+    return wrap(jnp.stack([r, c]).astype(_core.to_jax_dtype(dtype)))

@@ -624,3 +624,119 @@ def as_strided(x, shape, stride, offset=0, name=None):
 
 def view_as(x, other, name=None):
     return reshape(x, coerce(other).shape)
+
+
+# ---------------------------------------------------------------------------
+# long-tail manipulation ops (round 4: §2.3 API-breadth pass)
+# ---------------------------------------------------------------------------
+
+
+def hsplit(x, num_or_indices, name=None):
+    """Split along axis 1 (axis 0 for 1-D), numpy semantics."""
+    x = coerce(x)
+    axis = 0 if len(x.shape) == 1 else 1
+    return split(x, num_or_indices, axis=axis)
+
+
+def vsplit(x, num_or_indices, name=None):
+    x = coerce(x)
+    return split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    x = coerce(x)
+    return split(x, num_or_indices, axis=2)
+
+
+def permute(x, *perm):
+    """torch-compat alias of transpose (also a Tensor method upstream)."""
+    if len(perm) == 1 and isinstance(perm[0], (list, tuple)):
+        perm = tuple(perm[0])
+    return transpose(coerce(x), list(perm))
+
+
+def take(x, index, mode="raise", name=None):
+    """Flat-index gather (reference: paddle.take)."""
+    x, index = coerce(x), coerce(index)
+
+    def f(a, i):
+        flat = a.reshape(-1)
+        ii = i.astype(jnp.int32)
+        n = flat.shape[0]
+        if mode == "wrap":
+            ii = ((ii % n) + n) % n
+        elif mode == "clip":
+            ii = jnp.clip(ii, 0, n - 1)
+        else:  # 'raise' semantics can't raise under XLA; negative wrap only
+            ii = jnp.where(ii < 0, ii + n, ii)
+        return jnp.take(flat, ii, axis=0)
+
+    return apply(f, [x, index], name="take")
+
+
+def index_fill(x, index, axis, value, name=None):
+    x, index = coerce(x), coerce(index)
+
+    def f(a, i):
+        moved = jnp.moveaxis(a, axis, 0)
+        filled = moved.at[i.astype(jnp.int32)].set(jnp.asarray(value, a.dtype))
+        return jnp.moveaxis(filled, 0, axis)
+
+    return apply(f, [x, index], name="index_fill")
+
+
+def index_fill_(x, index, axis, value, name=None):
+    from .dispatch import inplace_rebind
+
+    return inplace_rebind(x, index_fill(x, index, axis, value))
+
+
+def unflatten(x, axis, shape, name=None):
+    x = coerce(x)
+
+    def f(a):
+        ax = axis % a.ndim
+        new = list(a.shape[:ax]) + list(shape) + list(a.shape[ax + 1 :])
+        # one -1 allowed (inferred)
+        if -1 in shape:
+            known = 1
+            for s in shape:
+                if s != -1:
+                    known *= s
+            new[new.index(-1)] = a.shape[ax] // known
+        return a.reshape(new)
+
+    return apply(f, [x], name="unflatten")
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along `axis` (reference: paddle.unfold / Tensor.unfold):
+    output gains a trailing window dim of length `size`."""
+    x = coerce(x)
+
+    def f(a):
+        ax = axis % a.ndim
+        length = a.shape[ax]
+        n_win = (length - size) // step + 1
+        starts = jnp.arange(n_win) * step
+        idx = starts[:, None] + jnp.arange(size)[None, :]  # [n_win, size]
+        moved = jnp.moveaxis(a, ax, 0)  # [L, ...]
+        wins = moved[idx]  # [n_win, size, ...]
+        wins = jnp.moveaxis(wins, 1, -1)  # [n_win, ..., size]
+        return jnp.moveaxis(wins, 0, ax)
+
+    return apply(f, [x], name="unfold")
+
+
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1, name=None):
+    """Relabel global ids to shard-local ids (reference: paddle.shard_index)."""
+    x = coerce(x)
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def f(a):
+        lo = shard_id * shard_size
+        hi = lo + shard_size
+        inside = (a >= lo) & (a < hi)
+        return jnp.where(inside, a - lo, ignore_value).astype(a.dtype)
+
+    return apply(f, [x], name="shard_index")

@@ -298,3 +298,160 @@ def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
 def equal_all(x, y, name=None):
     x, y = coerce(x), coerce(y)
     return apply(lambda a, b: jnp.array_equal(a, b), [x, y], name="equal_all")
+
+
+# ---------------------------------------------------------------------------
+# long-tail math ops (round 4: §2.3 API-breadth pass)
+# ---------------------------------------------------------------------------
+
+
+def add_n(inputs, name=None):
+    """Sum a list of tensors (reference: paddle.add_n)."""
+    ts = [coerce(t) for t in inputs]
+
+    def f(*arrs):
+        out = arrs[0]
+        for a in arrs[1:]:
+            out = out + a
+        return out
+
+    return apply(f, ts, name="add_n")
+
+
+def ldexp(x, y, name=None):
+    x, y = coerce(x), coerce(y)
+    return apply(lambda a, b: (a * jnp.exp2(b.astype(jnp.float32))).astype(jnp.result_type(a, jnp.float32)), [x, y], name="ldexp")
+
+
+def logcumsumexp(x, axis=None, name=None):
+    x = coerce(x)
+
+    def f(a):
+        ax = axis
+        if ax is None:
+            a = a.reshape(-1)
+            ax = 0
+        # logaddexp is associative: a numerically-stable parallel scan
+        return jax.lax.associative_scan(jnp.logaddexp, a, axis=ax)
+
+    return apply(f, [x], name="logcumsumexp")
+
+
+def sinc(x, name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.sinc(a), [x], name="sinc")
+
+
+def signbit(x, name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.signbit(a), [x], name="signbit")
+
+
+def sgn(x, name=None):
+    """sign for real; unit complex phase for complex (reference: paddle.sgn)."""
+    x = coerce(x)
+
+    def f(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0, a / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(a)
+
+    return apply(f, [x], name="sgn")
+
+
+def polar(abs, angle, name=None):
+    abs, angle = coerce(abs), coerce(angle)
+    return apply(lambda r, t: (r * jnp.cos(t) + 1j * r * jnp.sin(t)).astype(jnp.complex64), [abs, angle], name="polar")
+
+
+def polygamma(x, n, name=None):
+    x = coerce(x)
+    from jax.scipy.special import polygamma as _pg
+
+    return apply(lambda a: _pg(int(n), a), [x], name="polygamma")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    x = coerce(x)
+    return apply(
+        lambda a: jnp.nanquantile(a.astype(jnp.float32), q, axis=axis, keepdims=keepdim),
+        [x],
+        name="nanquantile",
+    )
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    """Pairwise p-norm distance [.., M, D] x [.., N, D] -> [.., M, N]."""
+    x, y = coerce(x), coerce(y)
+
+    def f(a, b):
+        if p == 2.0:
+            # matmul form rides the MXU: |a-b|^2 = |a|^2 + |b|^2 - 2ab
+            a2 = jnp.sum(a * a, -1)[..., :, None]
+            b2 = jnp.sum(b * b, -1)[..., None, :]
+            ab = jnp.matmul(a, jnp.swapaxes(b, -1, -2))
+            return jnp.sqrt(jnp.maximum(a2 + b2 - 2 * ab, 0.0))
+        d = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        return jnp.sum(d**p, -1) ** (1.0 / p)
+
+    return apply(f, [x, y], name="cdist")
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of [N, D] (upper triangle, row-major)."""
+    x = coerce(x)
+
+    def f(a):
+        n = a.shape[0]
+        full = jnp.abs(a[:, None, :] - a[None, :, :])
+        d = jnp.sum(full**p, -1) ** (1.0 / p)
+        iu = jnp.triu_indices(n, k=1)
+        return d[iu]
+
+    return apply(f, [x], name="pdist")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    x = coerce(x)
+
+    def f(a):
+        ax = axis % a.ndim
+        other = tuple(i for i in range(a.ndim) if i != ax)
+        norms = jnp.sum(jnp.abs(a) ** p, axis=other, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        return a * factor.astype(a.dtype)
+
+    return apply(f, [x], name="renorm")
+
+
+def vander(x, n=None, increasing=False, name=None):
+    x = coerce(x)
+    cols = n if n is not None else x.shape[0]
+    return apply(lambda a: jnp.vander(a, N=cols, increasing=increasing), [x], name="vander")
+
+
+def is_complex(x):
+    return jnp.issubdtype(coerce(x)._raw.dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(coerce(x)._raw.dtype, jnp.floating)
+
+
+def is_empty(x, name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.asarray(a.size == 0), [x], name="is_empty")
+
+
+def rank(x, name=None):
+    x = coerce(x)
+    return apply(lambda a: jnp.asarray(a.ndim, jnp.int32), [x], name="rank")
+
+
+def tensordot(x, y, axes=2, name=None):
+    x, y = coerce(x), coerce(y)
+    ax = axes
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in ax)
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=ax), [x, y], name="tensordot")

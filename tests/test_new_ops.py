@@ -8,6 +8,10 @@ import paddle_tpu as paddle
 import paddle_tpu.nn.functional as F
 
 
+def t(arr, rg=False):
+    return paddle.to_tensor(np.asarray(arr), stop_gradient=not rg)
+
+
 def test_thresholded_relu():
     x = paddle.to_tensor(np.array([-1.0, 0.5, 2.0], np.float32))
     np.testing.assert_allclose(F.thresholded_relu(x).numpy(), [0.0, 0.0, 2.0])
@@ -48,3 +52,216 @@ def test_label_smooth():
     out = paddle.label_smooth(oh, epsilon=0.2)
     np.testing.assert_allclose(out.numpy()[0], [0.85, 0.05, 0.05, 0.05], rtol=1e-6)
     assert hasattr(F, "label_smooth")
+
+
+class TestRound4LongTail:
+    """Round-4 API-breadth ops vs numpy oracles (§2.3 long tail)."""
+
+    def test_add_n_ldexp_sinc_signbit_sgn(self):
+        a = np.array([1.0, -2.0, 0.5], np.float32)
+        b = np.array([2.0, 1.0, -1.0], np.float32)
+        np.testing.assert_allclose(paddle.add_n([t(a), t(b), t(a)]).numpy(), 2 * a + b)
+        np.testing.assert_allclose(paddle.ldexp(t(a), t(np.array([1, 2, 3], np.int32))).numpy(), np.ldexp(a, [1, 2, 3]), rtol=1e-6)
+        np.testing.assert_allclose(paddle.sinc(t(a)).numpy(), np.sinc(a), rtol=1e-6)
+        np.testing.assert_array_equal(paddle.signbit(t(a)).numpy(), np.signbit(a))
+        np.testing.assert_allclose(paddle.sgn(t(a)).numpy(), np.sign(a))
+
+    def test_logcumsumexp(self):
+        a = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+        got = paddle.logcumsumexp(t(a), axis=1).numpy()
+        ref = np.logaddexp.accumulate(a, axis=1)
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_cdist_pdist(self):
+        rng = np.random.RandomState(1)
+        x = rng.rand(5, 3).astype(np.float32)
+        y = rng.rand(4, 3).astype(np.float32)
+        ref = np.sqrt(((x[:, None, :] - y[None, :, :]) ** 2).sum(-1))
+        np.testing.assert_allclose(paddle.cdist(t(x), t(y)).numpy(), ref, rtol=1e-4, atol=1e-5)
+        full = np.sqrt(((x[:, None, :] - x[None, :, :]) ** 2).sum(-1))
+        iu = np.triu_indices(5, k=1)
+        np.testing.assert_allclose(paddle.pdist(t(x)).numpy(), full[iu], rtol=1e-5, atol=1e-6)
+
+    def test_renorm_vander_tensordot(self):
+        rng = np.random.RandomState(2)
+        x = rng.rand(3, 4).astype(np.float32) * 5
+        out = paddle.renorm(t(x), p=2.0, axis=0, max_norm=1.0).numpy()
+        norms = np.linalg.norm(out, axis=1)
+        assert (norms <= 1.0 + 1e-5).all()
+        v = np.array([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(paddle.vander(t(v)).numpy(), np.vander(v), rtol=1e-6)
+        a = rng.rand(2, 3, 4).astype(np.float32)
+        b = rng.rand(4, 3, 5).astype(np.float32)
+        ref = np.tensordot(a, b, axes=([1, 2], [1, 0]))
+        np.testing.assert_allclose(
+            paddle.tensordot(t(a), t(b), axes=([1, 2], [1, 0])).numpy(), ref, rtol=1e-5
+        )
+
+    def test_splits_permute(self):
+        a = np.arange(24, dtype=np.float32).reshape(2, 6, 2)
+        hs = paddle.hsplit(t(a), 3)
+        assert len(hs) == 3 and hs[0].shape == [2, 2, 2]
+        vs = paddle.vsplit(t(a), 2)
+        assert vs[0].shape == [1, 6, 2]
+        ds = paddle.dsplit(t(a), 2)
+        assert ds[0].shape == [2, 6, 1]
+        np.testing.assert_array_equal(
+            paddle.permute(t(a), 2, 0, 1).numpy(), np.transpose(a, (2, 0, 1))
+        )
+
+    def test_take_index_fill_unflatten_unfold(self):
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        np.testing.assert_allclose(
+            paddle.take(t(a), t(np.array([0, 5, -1], np.int64))).numpy(), [0, 5, 11]
+        )
+        out = paddle.index_fill(t(a), t(np.array([0, 2], np.int64)), 0, -1.0).numpy()
+        assert (out[0] == -1).all() and (out[2] == -1).all() and (out[1] == a[1]).all()
+        np.testing.assert_array_equal(
+            paddle.unflatten(t(a), 1, [2, 2]).numpy(), a.reshape(3, 2, 2)
+        )
+        u = paddle.unfold(t(np.arange(6, dtype=np.float32)), 0, 3, 2).numpy()
+        np.testing.assert_array_equal(u, [[0, 1, 2], [2, 3, 4]])
+
+    def test_tri_indices_and_predicates(self):
+        np.testing.assert_array_equal(
+            paddle.tril_indices(3).numpy(), np.stack(np.tril_indices(3))
+        )
+        np.testing.assert_array_equal(
+            paddle.triu_indices(3, offset=1).numpy(), np.stack(np.triu_indices(3, k=1))
+        )
+        assert paddle.is_floating_point(t(np.ones(2, np.float32)))
+        assert not paddle.is_complex(t(np.ones(2, np.float32)))
+        assert int(paddle.rank(t(np.ones((2, 3)))).numpy()) == 2
+        assert not bool(paddle.is_empty(t(np.ones(2))).numpy())
+
+    def test_shard_index(self):
+        lab = np.array([1, 6, 11, 15], np.int64)
+        out = paddle.shard_index(t(lab), index_num=16, nshards=2, shard_id=1).numpy()
+        np.testing.assert_array_equal(out, [-1, -1, 3, 7])
+
+    def test_polar_polygamma_nanquantile(self):
+        r = np.array([1.0, 2.0], np.float32)
+        th = np.array([0.0, np.pi / 2], np.float32)
+        got = paddle.polar(t(r), t(th)).numpy()
+        np.testing.assert_allclose(got, r * np.exp(1j * th), rtol=1e-5, atol=1e-6)
+        x = np.array([1.0, 2.0, 3.0], np.float32)
+        from scipy.special import polygamma as sp_pg
+
+        np.testing.assert_allclose(
+            paddle.polygamma(t(x), 1).numpy(), sp_pg(1, x).astype(np.float32), rtol=1e-4
+        )
+        a = np.array([1.0, np.nan, 3.0, 5.0], np.float32)
+        np.testing.assert_allclose(
+            float(paddle.nanquantile(t(a), 0.5).numpy()), 3.0, rtol=1e-5
+        )
+
+
+class TestRound4FunctionalLayers:
+    """Round-4 nn/F breadth: losses, 3D pools, fold/unfold, transpose convs."""
+
+    def test_simple_losses(self):
+        rng = np.random.RandomState(0)
+        x = rng.rand(4, 3).astype(np.float32)
+        y = rng.rand(4, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            F.square_error_cost(t(x), t(y)).numpy(), (x - y) ** 2, rtol=1e-6
+        )
+        p = np.clip(rng.rand(4), 0.05, 0.95).astype(np.float32)
+        lab = (rng.rand(4) > 0.5).astype(np.float32)
+        ref = -lab * np.log(p + 1e-4) - (1 - lab) * np.log(1 - p + 1e-4)
+        np.testing.assert_allclose(F.log_loss(t(p), t(lab)).numpy(), ref, rtol=1e-5)
+        d = x - y
+        h_ref = np.where(np.abs(d) <= 1.0, 0.5 * d * d, np.abs(d) - 0.5).mean()
+        np.testing.assert_allclose(float(F.huber_loss(t(x), t(y)).numpy()), h_ref, rtol=1e-5)
+        pd = F.pairwise_distance(t(x), t(y)).numpy()
+        np.testing.assert_allclose(pd, np.linalg.norm(x - y + 1e-6, axis=-1), rtol=1e-5)
+
+    def test_bilinear(self):
+        rng = np.random.RandomState(1)
+        x1 = rng.rand(5, 3).astype(np.float32)
+        x2 = rng.rand(5, 4).astype(np.float32)
+        w = rng.rand(2, 3, 4).astype(np.float32)
+        ref = np.einsum("bi,oij,bj->bo", x1, w, x2)
+        np.testing.assert_allclose(F.bilinear(t(x1), t(x2), t(w)).numpy(), ref, rtol=1e-5)
+
+    def test_pixel_unshuffle_roundtrip(self):
+        rng = np.random.RandomState(2)
+        x = rng.rand(2, 3, 4, 4).astype(np.float32)
+        up = F.pixel_shuffle(t(rng.rand(2, 12, 2, 2).astype(np.float32)), 2)
+        back = F.pixel_unshuffle(up, 2)
+        np.testing.assert_allclose(
+            F.pixel_shuffle(back, 2).numpy(), up.numpy(), rtol=1e-6
+        )
+
+    def test_zeropad2d(self):
+        x = np.ones((1, 1, 2, 2), np.float32)
+        out = F.zeropad2d(t(x), [1, 2, 0, 1]).numpy()
+        assert out.shape == (1, 1, 3, 5)
+        assert out.sum() == 4.0
+
+    def test_fold_inverts_unfold_counts(self):
+        rng = np.random.RandomState(3)
+        x = rng.rand(2, 3, 6, 6).astype(np.float32)
+        cols = F.unfold(t(x), 3, strides=3)  # non-overlapping -> exact inverse
+        back = F.fold(cols, [6, 6], 3, strides=3).numpy()
+        np.testing.assert_allclose(back, x, rtol=1e-6)
+
+    def test_ctc_loss_vs_torch(self):
+        import torch
+
+        rng = np.random.RandomState(4)
+        T, B, C, S = 10, 2, 5, 3
+        logits = rng.randn(T, B, C).astype(np.float32)
+        labels = rng.randint(1, C, (B, S)).astype(np.int32)
+        in_len = np.array([10, 7], np.int64)
+        lab_len = np.array([3, 2], np.int64)
+        ref = torch.nn.functional.ctc_loss(
+            torch.log_softmax(torch.tensor(logits), -1),
+            torch.tensor(labels.astype(np.int64)),
+            torch.tensor(in_len), torch.tensor(lab_len),
+            blank=0, reduction="none",
+        ).numpy()
+        got = F.ctc_loss(
+            t(logits), t(labels), t(in_len), t(lab_len), reduction="none"
+        ).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)
+
+    def test_pool3d(self):
+        rng = np.random.RandomState(5)
+        x = rng.rand(1, 2, 4, 4, 4).astype(np.float32)
+        mp = F.max_pool3d(t(x), 2, 2).numpy()
+        ref = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max((3, 5, 7))
+        np.testing.assert_allclose(mp, ref, rtol=1e-6)
+        ap = F.avg_pool3d(t(x), 2, 2).numpy()
+        refa = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean((3, 5, 7))
+        np.testing.assert_allclose(ap, refa, rtol=1e-6)
+        ad = F.adaptive_avg_pool3d(t(x), 2).numpy()
+        np.testing.assert_allclose(ad, refa, rtol=1e-6)
+
+    def test_conv3d_transpose_shape_and_grad(self):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        layer = nn.Conv3DTranspose(2, 3, 3, stride=2, padding=1, output_padding=1)
+        x = t(np.random.RandomState(6).rand(1, 2, 4, 4, 4).astype(np.float32), rg=True)
+        out = layer(x)
+        assert out.shape == [1, 3, 8, 8, 8]
+        out.sum().backward()
+        assert layer.weight.grad is not None
+
+    def test_new_layers_smoke(self):
+        import paddle_tpu.nn as nn
+
+        x = t(np.random.RandomState(7).rand(2, 6).astype(np.float32))
+        assert nn.SiLU()(x).shape == [2, 6]
+        assert nn.GLU()(x).shape == [2, 3]
+        assert nn.LogSigmoid()(x).shape == [2, 6]
+        assert nn.Unflatten(1, [2, 3])(x).shape == [2, 2, 3]
+        img = t(np.random.RandomState(8).rand(1, 4, 4, 4).astype(np.float32))
+        assert nn.PixelUnshuffle(2)(img).shape == [1, 16, 2, 2]
+        assert nn.ZeroPad2D(1)(img).shape == [1, 4, 6, 6]
+        y = t(np.random.RandomState(9).rand(2, 6).astype(np.float32))
+        assert nn.PairwiseDistance()(x, y).shape == [2]
+        lab = t((np.random.RandomState(10).rand(2, 6) > 0.5).astype(np.float32))
+        loss = nn.MultiLabelSoftMarginLoss()(x, lab)
+        assert np.isfinite(float(loss.numpy()))

@@ -100,6 +100,17 @@ class Group:
                 return self.ranks.index(global_rank)
             except ValueError:
                 return -1
+        if self.axis_name is not None:
+            m = _mesh.get_mesh()
+            if m is not None and self.axis_name in m.axis_names:
+                # the process's true coordinate along the axis comes from
+                # the mesh's device assignment — global_rank % nranks is
+                # only right for the innermost axis (round-3 weak finding)
+                arr = np.asarray(m.devices)
+                ax = list(m.axis_names).index(self.axis_name)
+                for idx, dev in np.ndenumerate(arr):
+                    if getattr(dev, "process_index", 0) == global_rank:
+                        return int(idx[ax])
         return global_rank % self.nranks
 
     @property

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Single build+test entry (reference: paddle/scripts/paddle_build.sh —
+# SURVEY.md §2.4 "CI entry").  Builds the native core, runs its gtest,
+# then the full Python suite on the 8-device CPU-sim mesh, and finally a
+# CPU smoke of the benchmark matrix.  Usage: ./ci.sh [fast]
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== native build =="
+cmake -S csrc -B csrc/build -G Ninja -DCMAKE_BUILD_TYPE=Release
+cmake --build csrc/build
+
+echo "== native tests =="
+./csrc/build/core_test
+
+MODE="${1:-}"
+if [ -n "$MODE" ] && [ "$MODE" != "fast" ]; then
+  echo "usage: ./ci.sh [fast]" >&2
+  exit 2
+fi
+
+echo "== python suite (8-device CPU mesh) =="
+PYTEST_ARGS=""
+[ "$MODE" = "fast" ] && PYTEST_ARGS="-x"
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/ -q $PYTEST_ARGS
+
+if [ "$MODE" != "fast" ]; then
+  echo "== bench smoke (CPU) =="
+  env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --all
+fi
+
+echo "CI OK"

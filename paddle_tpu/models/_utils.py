@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+import numpy as np
+
 from .. import ops
 from ..nn import functional as F
 
@@ -19,3 +21,83 @@ def sequence_ce(model, logits, labels, ignore_index=-100):
         valid = (flat != ignore_index).astype(per_tok.dtype)
         return per_tok.sum() / ops.clip(valid.sum(), min=1.0)
     return F.cross_entropy(logits.reshape([-1, vocab]), flat, ignore_index=ignore_index)
+
+
+def compiled_generate(model, input_ids, max_new_tokens, temperature, forward_step, kv_heads):
+    """Shared compiled static-KV generation loop (reference: the inference
+    runtime's flash-decode path, SURVEY §2.1 L8) used by Llama and GPT.
+
+    forward_step(toks, caches, pos) -> last-token logits.  Caches are
+    preallocated StaticKVCache buffers in the model's parameter dtype
+    (bf16 under AMP-O2 decorate); prefill/decode each compile ONCE per
+    (batch, cache bucket, sampling mode) and the greedy hot loop is a
+    single executable dispatch per token.
+    """
+    from .. import jit, no_grad, to_tensor
+    from .llama import StaticKVCache
+
+    cfg = model.config
+    b, s0 = input_ids.shape[0], input_ids.shape[1]
+    if max_new_tokens <= 0:
+        return input_ids
+    # round the cache up to a 128 multiple so repeated generate() calls
+    # with nearby lengths reuse one compiled pair
+    want = min(cfg.max_position_embeddings, s0 + max_new_tokens)
+    cache_len = min(cfg.max_position_embeddings, -(-want // 128) * 128)
+    if s0 + max_new_tokens > cache_len:
+        import logging
+
+        logging.getLogger("paddle_tpu").warning(
+            "generate: prompt %d + max_new_tokens %d exceeds "
+            "max_position_embeddings %d; output truncated to %d new tokens",
+            s0, max_new_tokens, cfg.max_position_embeddings, max(cache_len - s0, 0),
+        )
+
+    token_dtype = input_ids.dtype
+    key = (b, cache_len, str(token_dtype))
+    if getattr(model, "_gen_cache_key", None) != key:
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        cache_dtype = model.lm_head.weight.dtype  # bf16 under AMP-O2 decorate
+        caches = [
+            StaticKVCache(b, cache_len, kv_heads, head_dim, cache_dtype)
+            for _ in range(cfg.num_hidden_layers)
+        ]
+
+        def _step(toks, pos, greedy):
+            logits = forward_step(toks, caches, pos)
+            new_pos = pos + toks.shape[1]
+            if greedy:
+                return ops.argmax(logits, axis=-1, keepdim=True).astype(token_dtype), new_pos
+            return logits, new_pos
+
+        fns = {
+            "prefill_greedy": jit.to_static(lambda t, p: _step(t, p, True)),
+            "decode_greedy": jit.to_static(lambda t, p: _step(t, p, True)),
+            "prefill_logits": jit.to_static(lambda t, p: _step(t, p, False)),
+            "decode_logits": jit.to_static(lambda t, p: _step(t, p, False)),
+        }
+        model._gen_cache_key = key
+        model._gen_caches, model._gen_fns = caches, fns
+    fns = model._gen_fns
+
+    with no_grad():
+        pos0 = to_tensor(np.int32(0))
+        pieces = [input_ids]
+        if temperature <= 0:
+            nxt, pos = fns["prefill_greedy"](input_ids, pos0)
+            pieces.append(nxt)
+            for i in range(1, max_new_tokens):
+                if s0 + i >= cache_len:
+                    break
+                nxt, pos = fns["decode_greedy"](nxt, pos)
+                pieces.append(nxt)
+        else:
+            logits, pos = fns["prefill_logits"](input_ids, pos0)
+            for i in range(max_new_tokens):
+                probs = F.softmax(logits / temperature, axis=-1)
+                nxt = ops.multinomial(probs, 1).astype(token_dtype)
+                pieces.append(nxt)
+                if i + 1 >= max_new_tokens or s0 + i + 1 >= cache_len:
+                    break
+                logits, pos = fns["decode_logits"](nxt, pos)
+        return ops.concat(pieces, axis=1)

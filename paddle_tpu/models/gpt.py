@@ -93,13 +93,22 @@ class GPTAttention(nn.Layer):
             self.qkv_proj = nn.Linear(h, 3 * h)
             self.out_proj = nn.Linear(h, h)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=None):
         b, s = x.shape[0], x.shape[1]
         qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads, self.head_dim])
         q, k, v = ops.unbind(qkv, axis=2)
-        out = F.scaled_dot_product_attention(
-            q, k, v, dropout_p=self.dropout, is_causal=True, training=self.training
-        )
+        if cache is not None:
+            # compiled static-KV decode (same machinery as models/llama.py)
+            from .llama import _cache_write, _decode_mask
+
+            cache.k._data = _cache_write(cache.k, k, pos)._data
+            cache.v._data = _cache_write(cache.v, v, pos)._data
+            mask = _decode_mask(s, cache.max_len, pos)
+            out = F.scaled_dot_product_attention(q, cache.k, cache.v, attn_mask=mask)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, dropout_p=self.dropout, is_causal=True, training=self.training
+            )
         out = out.reshape([b, s, self.num_heads * self.head_dim])
         return self.out_proj(out)
 
@@ -140,11 +149,13 @@ class GPTDecoderLayer(nn.Layer):
             self.mlp = GPTMLP(config)
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
 
-    def _block(self, x):
-        x = x + self.dropout(self.attn(self.ln_1(x)))
+    def _block(self, x, cache=None, pos=None):
+        x = x + self.dropout(self.attn(self.ln_1(x), cache=cache, pos=pos))
         return x + self.dropout(self.mlp(self.ln_2(x)))
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=None):
+        if cache is not None:
+            return self._block(x, cache, pos)
         if self.config.use_recompute and self.training:
             from ..incubate.recompute import recompute
 
@@ -162,10 +173,14 @@ class GPTEmbeddings(nn.Layer):
         self.position_embeddings = nn.Embedding(config.max_position_embeddings, config.hidden_size)
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, pos=None):
         s = input_ids.shape[1]
-        pos = ops.arange(0, s, dtype="int32")
-        x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if pos is None:
+            positions = ops.arange(0, s, dtype="int32")
+        else:
+            # decode: absolute positions start at the cache write offset
+            positions = ops.arange(0, s, dtype="int32") + pos
+        x = self.word_embeddings(input_ids) + self.position_embeddings(positions)
         return self.dropout(x)
 
 
@@ -184,7 +199,12 @@ class GPTModel(nn.Layer):
         )
         self.ln_f = nn.LayerNorm(config.hidden_size, config.layer_norm_epsilon)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, pos=None):
+        if caches is not None:
+            x = self.embeddings(input_ids, pos=pos)
+            for layer, c in zip(self.h, caches):
+                x = layer(x, cache=c, pos=pos)
+            return self.ln_f(x)
         x = self.embeddings(input_ids)
         for layer in self.h:
             x = layer(x)
@@ -222,6 +242,21 @@ class GPTForCausalLM(nn.Layer):
                 loss = loss + self.config.moe_aux_coeff * total_aux
             return loss, logits
         return logits
+
+
+    def generate(self, input_ids, max_new_tokens=16, temperature=0.0):
+        """Greedy/temperature decoding over the shared compiled static-KV
+        step (models/_utils.compiled_generate)."""
+        from ._utils import compiled_generate
+
+        def forward_step(toks, caches, pos):
+            hidden = self.gpt(toks, caches=caches, pos=pos)
+            return self.lm_head(hidden)[:, -1]
+
+        return compiled_generate(
+            self, input_ids, max_new_tokens, temperature, forward_step,
+            kv_heads=self.config.num_attention_heads,
+        )
 
 
 GPTForPretraining = GPTForCausalLM

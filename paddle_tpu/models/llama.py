@@ -367,91 +367,17 @@ class LlamaForCausalLM(nn.Layer):
             return loss, logits
         return logits
 
-    def _gen_state(self, b, cache_len, dtype):
-        """Static KV caches + compiled prefill/decode steps, reused across
-        generate() calls.  The cache tensors are jit STATE (read + written
-        in place), so they must be the same objects every call; the decode
-        step compiles once and serves every token.  Greedy sampling and the
-        position increment live INSIDE the compiled step, so the hot loop
-        is exactly one executable dispatch per token — per-token eager ops
-        (argmax/concat/device scalars) measurably dominated decode latency
-        through the device transport."""
-        key = (b, cache_len, str(dtype))
-        if getattr(self, "_gen_cache_key", None) == key:
-            return self._gen_caches, self._gen_fns
-        from .. import jit
-
-        cfg = self.config
-        head_dim = cfg.hidden_size // cfg.num_attention_heads
-        cache_dtype = self.lm_head.weight.dtype  # bf16 under AMP-O2 decorate
-        caches = [
-            StaticKVCache(b, cache_len, cfg.num_key_value_heads, head_dim, cache_dtype)
-            for _ in range(cfg.num_hidden_layers)
-        ]
-
-        def _step(toks, pos, greedy):
-            hidden, _ = self.llama(toks, caches=caches, pos=pos)
-            logits = self.lm_head(hidden)[:, -1]
-            new_pos = pos + toks.shape[1]
-            if greedy:
-                nxt = ops.argmax(logits, axis=-1, keepdim=True).astype(dtype)
-                return nxt, new_pos
-            return logits, new_pos
-
-        fns = {
-            "prefill_greedy": jit.to_static(lambda t, p: _step(t, p, True)),
-            "decode_greedy": jit.to_static(lambda t, p: _step(t, p, True)),
-            "prefill_logits": jit.to_static(lambda t, p: _step(t, p, False)),
-            "decode_logits": jit.to_static(lambda t, p: _step(t, p, False)),
-        }
-        self._gen_cache_key = key
-        self._gen_caches, self._gen_fns = caches, fns
-        return caches, fns
-
     def generate(self, input_ids, max_new_tokens=16, temperature=0.0):
-        """Greedy/temperature sampling over a compiled decode step with a
-        preallocated static-shape KV cache: after the first token there are
-        ZERO recompiles and (greedy) ONE dispatch per token — the same
-        executable runs every step with the position carried as a device
-        scalar (reference: inference runtime flash-decode path, SURVEY
-        §2.1 L8)."""
-        from .. import no_grad, to_tensor
+        """Greedy/temperature sampling over the shared compiled static-KV
+        decode step (models/_utils.compiled_generate): one executable
+        dispatch per token after the first compile."""
+        from ._utils import compiled_generate
 
-        cfg = self.config
-        b, s0 = input_ids.shape[0], input_ids.shape[1]
-        if max_new_tokens <= 0:
-            return input_ids
-        # round the cache up to a 128 multiple so repeated generate() calls
-        # with nearby lengths reuse one compiled pair
-        want = min(cfg.max_position_embeddings, s0 + max_new_tokens)
-        cache_len = min(cfg.max_position_embeddings, -(-want // 128) * 128)
-        if s0 + max_new_tokens > cache_len:
-            import logging
+        def forward_step(toks, caches, pos):
+            hidden, _ = self.llama(toks, caches=caches, pos=pos)
+            return self.lm_head(hidden)[:, -1]
 
-            logging.getLogger("paddle_tpu").warning(
-                "generate: prompt %d + max_new_tokens %d exceeds "
-                "max_position_embeddings %d; output truncated to %d new tokens",
-                s0, max_new_tokens, cfg.max_position_embeddings, max(cache_len - s0, 0),
-            )
-        with no_grad():
-            caches, fns = self._gen_state(b, cache_len, input_ids.dtype)
-            pos0 = to_tensor(np.int32(0))
-            pieces = [input_ids]
-            if temperature <= 0:
-                nxt, pos = fns["prefill_greedy"](input_ids, pos0)
-                pieces.append(nxt)
-                for i in range(1, max_new_tokens):
-                    if s0 + i >= cache_len:
-                        break
-                    nxt, pos = fns["decode_greedy"](nxt, pos)
-                    pieces.append(nxt)
-            else:
-                logits, pos = fns["prefill_logits"](input_ids, pos0)
-                for i in range(max_new_tokens):
-                    probs = F.softmax(logits / temperature, axis=-1)
-                    nxt = ops.multinomial(probs, 1).astype(input_ids.dtype)
-                    pieces.append(nxt)
-                    if i + 1 >= max_new_tokens or s0 + i + 1 >= cache_len:
-                        break
-                    logits, pos = fns["decode_logits"](nxt, pos)
-            return ops.concat(pieces, axis=1)
+        return compiled_generate(
+            self, input_ids, max_new_tokens, temperature, forward_step,
+            kv_heads=self.config.num_key_value_heads,
+        )

@@ -378,3 +378,21 @@ class TestBertPaddingMask:
         finally:
             fa._FORCE_INTERPRET = saved
             fa._fallback_logged = saved_logged
+
+
+class TestGPTDecode:
+    def test_generate_compiled_decode(self):
+        paddle.seed(2)
+        cfg = GPTConfig.tiny()
+        model = GPTForCausalLM(cfg)
+        x = ids(2, 8)
+        out = model.generate(x, max_new_tokens=5)
+        assert out.shape == [2, 13]
+        assert model._gen_fns["decode_greedy"].trace_count == 1
+        full = model(paddle.to_tensor(out.numpy()[:, :-1].astype(np.int32)))
+        np.testing.assert_array_equal(
+            np.argmax(full.numpy()[:, -1], -1), out.numpy()[:, -1]
+        )
+        out2 = model.generate(x, max_new_tokens=5)
+        np.testing.assert_array_equal(out.numpy(), out2.numpy())
+        assert model._gen_fns["decode_greedy"].trace_count == 1

@@ -6,18 +6,18 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
+MODE="${1:-}"
+if [ -n "$MODE" ] && [ "$MODE" != "fast" ]; then
+  echo "usage: ./ci.sh [fast]" >&2
+  exit 2
+fi
+
 echo "== native build =="
 cmake -S csrc -B csrc/build -G Ninja -DCMAKE_BUILD_TYPE=Release
 cmake --build csrc/build
 
 echo "== native tests =="
 ./csrc/build/core_test
-
-MODE="${1:-}"
-if [ -n "$MODE" ] && [ "$MODE" != "fast" ]; then
-  echo "usage: ./ci.sh [fast]" >&2
-  exit 2
-fi
 
 echo "== python suite (8-device CPU mesh) =="
 PYTEST_ARGS=""

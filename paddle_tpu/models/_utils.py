@@ -23,7 +23,43 @@ def sequence_ce(model, logits, labels, ignore_index=-100):
     return F.cross_entropy(logits.reshape([-1, vocab]), flat, ignore_index=ignore_index)
 
 
-def compiled_generate(model, input_ids, max_new_tokens, temperature, forward_step, kv_heads):
+def _filter_logits(logits, top_k, top_p):
+    """top-k / nucleus filtering on [b, V] logits (reference:
+    generation_utils TopKProcess/TopPProcess) — eager ops on a small array."""
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import apply, coerce
+
+    logits = coerce(logits)
+
+    def f(lg):
+        out = lg
+        if top_k and top_k > 0:
+            kth = jnp.sort(out, axis=-1)[:, -int(top_k)][:, None]
+            out = jnp.where(out < kth, -1e30, out)
+        if top_p is not None and top_p < 1.0:
+            sort_idx = jnp.argsort(out, axis=-1)[:, ::-1]
+            sorted_lg = jnp.take_along_axis(out, sort_idx, -1)
+            probs = jax_softmax(sorted_lg)
+            cum = jnp.cumsum(probs, -1)
+            # keep tokens until cumulative prob exceeds top_p (always >= 1)
+            keep_sorted = cum - probs < top_p
+            keep = jnp.zeros_like(keep_sorted).at[
+                jnp.arange(out.shape[0])[:, None], sort_idx
+            ].set(keep_sorted)
+            out = jnp.where(keep, out, -1e30)
+        return out
+
+    import jax
+
+    def jax_softmax(x):
+        return jax.nn.softmax(x, axis=-1)
+
+    return apply(f, [logits], name="sample_filter")
+
+
+def compiled_generate(model, input_ids, max_new_tokens, temperature, forward_step, kv_heads,
+                      top_k=0, top_p=1.0):
     """Shared compiled static-KV generation loop (reference: the inference
     runtime's flash-decode path, SURVEY §2.1 L8) used by Llama and GPT.
 
@@ -40,6 +76,12 @@ def compiled_generate(model, input_ids, max_new_tokens, temperature, forward_ste
     b, s0 = input_ids.shape[0], input_ids.shape[1]
     if max_new_tokens <= 0:
         return input_ids
+    # generation is inference: force eval so dropout never bakes into the
+    # cached decode executables (they are traced once and reused across
+    # later mode switches)
+    was_training = getattr(model, "training", False)
+    if was_training:
+        model.eval()
     # round the cache up to a 128 multiple so repeated generate() calls
     # with nearby lengths reuse one compiled pair
     want = min(cfg.max_position_embeddings, s0 + max_new_tokens)
@@ -94,10 +136,13 @@ def compiled_generate(model, input_ids, max_new_tokens, temperature, forward_ste
         else:
             logits, pos = fns["prefill_logits"](input_ids, pos0)
             for i in range(max_new_tokens):
-                probs = F.softmax(logits / temperature, axis=-1)
+                filtered = _filter_logits(logits / temperature, top_k, top_p)
+                probs = F.softmax(filtered, axis=-1)
                 nxt = ops.multinomial(probs, 1).astype(token_dtype)
                 pieces.append(nxt)
                 if i + 1 >= max_new_tokens or s0 + i + 1 >= cache_len:
                     break
                 logits, pos = fns["decode_logits"](nxt, pos)
+        if was_training:
+            model.train()
         return ops.concat(pieces, axis=1)

@@ -244,7 +244,7 @@ class GPTForCausalLM(nn.Layer):
         return logits
 
 
-    def generate(self, input_ids, max_new_tokens=16, temperature=0.0):
+    def generate(self, input_ids, max_new_tokens=16, temperature=0.0, top_k=0, top_p=1.0):
         """Greedy/temperature decoding over the shared compiled static-KV
         step (models/_utils.compiled_generate)."""
         from ._utils import compiled_generate
@@ -255,7 +255,7 @@ class GPTForCausalLM(nn.Layer):
 
         return compiled_generate(
             self, input_ids, max_new_tokens, temperature, forward_step,
-            kv_heads=self.config.num_attention_heads,
+            kv_heads=self.config.num_attention_heads, top_k=top_k, top_p=top_p,
         )
 
 

@@ -367,7 +367,7 @@ class LlamaForCausalLM(nn.Layer):
             return loss, logits
         return logits
 
-    def generate(self, input_ids, max_new_tokens=16, temperature=0.0):
+    def generate(self, input_ids, max_new_tokens=16, temperature=0.0, top_k=0, top_p=1.0):
         """Greedy/temperature sampling over the shared compiled static-KV
         decode step (models/_utils.compiled_generate): one executable
         dispatch per token after the first compile."""
@@ -379,5 +379,5 @@ class LlamaForCausalLM(nn.Layer):
 
         return compiled_generate(
             self, input_ids, max_new_tokens, temperature, forward_step,
-            kv_heads=self.config.num_key_value_heads,
+            kv_heads=self.config.num_key_value_heads, top_k=top_k, top_p=top_p,
         )

@@ -396,3 +396,24 @@ class TestGPTDecode:
         out2 = model.generate(x, max_new_tokens=5)
         np.testing.assert_array_equal(out.numpy(), out2.numpy())
         assert model._gen_fns["decode_greedy"].trace_count == 1
+
+
+class TestSampling:
+    def test_top_k_top_p_filtering(self):
+        from paddle_tpu.models._utils import _filter_logits
+
+        lg = paddle.to_tensor(np.array([[1.0, 3.0, 2.0, -1.0, 0.5]], np.float32))
+        fk = _filter_logits(lg, top_k=2, top_p=1.0).numpy()
+        assert (fk > -1e29).sum() == 2
+        assert fk[0, 1] == 3.0 and fk[0, 2] == 2.0
+        fp = _filter_logits(lg, top_k=0, top_p=0.95).numpy()
+        assert (fp > -1e29).sum() >= 1  # the top token always survives
+
+    def test_generate_with_sampling_args(self):
+        paddle.seed(1)
+        cfg = LlamaConfig.tiny()
+        model = LlamaForCausalLM(cfg)
+        x = ids(2, 8)
+        out = model.generate(x, max_new_tokens=4, temperature=0.8, top_k=5, top_p=0.9)
+        assert out.shape == [2, 12]
+        assert (out.numpy()[:, :8] == x.numpy()).all()

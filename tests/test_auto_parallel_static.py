@@ -97,3 +97,39 @@ class TestServing:
                 assert json.loads(r.read())["status"] == "ok"
         finally:
             server.shutdown()
+
+
+class TestGenerationServe:
+    def test_serve_generate_endpoint(self, tmp_path):
+        import json
+        import socket
+        import urllib.request
+
+        import paddle_tpu.inference as inference
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(0)
+        model = LlamaForCausalLM(LlamaConfig.tiny())
+        pred = inference.GenerationPredictor(model, max_new_tokens=4)
+
+        s = socket.socket(); s.bind(("", 0)); port = s.getsockname()[1]; s.close()
+        server = inference.serve(pred, port=port, block=False)
+        try:
+            ids = np.random.RandomState(0).randint(0, 256, (1, 8)).tolist()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps({"input_ids": ids, "max_new_tokens": 3}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                out = json.loads(r.read())
+            toks = np.asarray(out["tokens"])
+            assert toks.shape == (1, 11)
+            assert (toks[:, :8] == np.asarray(ids)).all()
+            # ref: direct generate must match the served tokens (greedy)
+            ref = model.generate(
+                paddle.to_tensor(np.asarray(ids, np.int32)), max_new_tokens=3
+            ).numpy()
+            np.testing.assert_array_equal(toks, ref)
+        finally:
+            server.shutdown()

@@ -13,17 +13,128 @@ class nn:
 
     class functional:
         @staticmethod
-        def fused_multi_head_attention(x, qkv_weight, qkv_bias=None, **k):
-            raise NotImplementedError(
-                "use paddle_tpu.nn.MultiHeadAttention (routes to Pallas flash)"
+        def fused_multi_head_attention(
+            x, qkv_weight, linear_weight, pre_layer_norm=False,
+            pre_ln_scale=None, pre_ln_bias=None, ln_scale=None, ln_bias=None,
+            pre_ln_epsilon=1e-5, qkv_bias=None, linear_bias=None,
+            cache_kv=None, attn_mask=None, dropout_rate=0.5,
+            attn_dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+            mode="upscale_in_train", ring_id=-1, add_residual=True,
+            num_heads=-1, transpose_qkv_wb=False, **k,
+        ):
+            """The reference's fused attention block (reference:
+            paddle/phi/kernels/fusion fused_attention): optional pre-LN,
+            QKV projection ([3, heads, head_dim, dim] weight), flash SDPA,
+            output projection, dropout, residual, optional post-LN.  The
+            CUDA mega-kernel's fusion happens in XLA here; attention rides
+            the Pallas kernel."""
+            from ..nn import functional as F
+            from ..ops.dispatch import apply, coerce
+            import jax.numpy as jnp
+
+            if ring_id not in (-1, 0):
+                raise NotImplementedError(
+                    "fused_multi_head_attention: tensor-parallel ring_id is "
+                    "handled by the mp-sharded layers, not this op"
+                )
+            if mode != "upscale_in_train":
+                raise NotImplementedError(
+                    "fused_multi_head_attention: only mode='upscale_in_train'"
+                )
+            x = coerce(x)
+            qkv_w = coerce(qkv_weight)
+            residual = x
+            h = x
+            if pre_layer_norm:
+                h = F.layer_norm(h, [h.shape[-1]], pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+            if transpose_qkv_wb:
+                # 2-D layout [dim, 3*dim] with explicit num_heads (reference
+                # transpose_qkv_wb=True)
+                if num_heads is None or num_heads <= 0:
+                    raise ValueError("transpose_qkv_wb=True requires num_heads")
+                dim = qkv_w.shape[0]
+                n_heads = num_heads
+                head_dim = dim // num_heads
+                from .. import ops as _reshape_ops
+
+                qkv_w = _reshape_ops.reshape(
+                    _reshape_ops.transpose(qkv_w, [1, 0]), [3, n_heads, head_dim, dim]
+                )
+            else:
+                n_heads, head_dim = qkv_w.shape[1], qkv_w.shape[2]
+            ins = [coerce(h), coerce(qkv_w)]
+            if qkv_bias is not None:
+                ins.append(coerce(qkv_bias))
+
+            def qkv_proj(a, w, *b):
+                out = jnp.einsum("bsd,thed->bsthe", a, w)  # [b,s,3,heads,hd]
+                if b:
+                    out = out + b[0].reshape(1, 1, 3, n_heads, head_dim)
+                return out
+
+            qkv = apply(qkv_proj, ins, name="fused_qkv")
+            from .. import ops as _ops
+
+            q, kk, v = _ops.unbind(qkv, axis=2)
+            new_cache = None
+            if cache_kv is not None:
+                # reference decode contract: cache_kv [2, b, heads, s_past,
+                # head_dim]; returns (out, updated cache)
+                cache_kv = coerce(cache_kv)
+                past_k, past_v = _ops.unbind(cache_kv, axis=0)  # [b,h,s,hd]
+                past_k = _ops.transpose(past_k, [0, 2, 1, 3])  # -> [b,s,h,hd]
+                past_v = _ops.transpose(past_v, [0, 2, 1, 3])
+                kk = _ops.concat([past_k, kk], axis=1)
+                v = _ops.concat([past_v, v], axis=1)
+                new_cache = _ops.stack(
+                    [_ops.transpose(kk, [0, 2, 1, 3]), _ops.transpose(v, [0, 2, 1, 3])],
+                    axis=0,
+                )
+            out = F.scaled_dot_product_attention(
+                q, kk, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate,
+                is_causal=False, training=training,
             )
+            b, s = out.shape[0], out.shape[1]
+            out = out.reshape([b, s, n_heads * head_dim])
+            out = F.linear(out, coerce(linear_weight), linear_bias)
+            if dropout_rate:
+                out = F.dropout(out, dropout_rate, training=training)
+            if add_residual:
+                out = residual + out
+            if not pre_layer_norm:
+                out = F.layer_norm(out, [out.shape[-1]], ln_scale, ln_bias, ln_epsilon)
+            if new_cache is not None:
+                return out, new_cache
+            return out
 
         @staticmethod
-        def fused_feedforward(x, linear1_weight, linear2_weight, **k):
+        def fused_feedforward(
+            x, linear1_weight, linear2_weight, linear1_bias=None,
+            linear2_bias=None, ln1_scale=None, ln1_bias=None, ln2_scale=None,
+            ln2_bias=None, dropout1_rate=0.5, dropout2_rate=0.5,
+            activation="relu", ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+            pre_layer_norm=False, training=True, add_residual=True, **k,
+        ):
             from ..nn import functional as F
+            from ..ops.dispatch import coerce
 
-            h = F.linear(x, linear1_weight)
-            return F.linear(F.relu(h), linear2_weight)
+            x = coerce(x)
+            residual = x
+            h = x
+            if pre_layer_norm:
+                h = F.layer_norm(h, [h.shape[-1]], ln1_scale, ln1_bias, ln1_epsilon)
+            h = F.linear(h, linear1_weight, linear1_bias)
+            h = getattr(F, activation)(h)
+            if dropout1_rate:
+                h = F.dropout(h, dropout1_rate, training=training)
+            h = F.linear(h, linear2_weight, linear2_bias)
+            if dropout2_rate:
+                h = F.dropout(h, dropout2_rate, training=training)
+            if add_residual:
+                h = residual + h
+            if not pre_layer_norm:
+                h = F.layer_norm(h, [h.shape[-1]], ln2_scale, ln2_bias, ln2_epsilon)
+            return h
 
         @staticmethod
         def fused_rms_norm(x, weight=None, epsilon=1e-6, **k):
@@ -90,3 +201,106 @@ class distributed:
 
                 def __init__(self, *a, **k):
                     raise NotImplementedError("use paddle_tpu.incubate.moe.MoELayer")
+
+
+# --- incubate.nn fused layer classes (defined after paddle_tpu.nn exists) ---
+def _define_fused_layers():
+    from ..nn.layer import Layer
+    from ..nn import initializer as I
+
+    class FusedMultiHeadAttention(Layer):
+        """Reference: paddle.incubate.nn.FusedMultiHeadAttention — the
+        attention block as one fused unit (pre/post-LN, QKV, SDPA, out
+        proj, dropout, residual); XLA does the fusing here."""
+
+        def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                     attn_dropout_rate=0.5, normalize_before=False,
+                     epsilon=1e-5, **k):
+            super().__init__()
+            self.epsilon = epsilon
+            self.num_heads = num_heads
+            self.head_dim = embed_dim // num_heads
+            self.normalize_before = normalize_before
+            self.dropout_rate = dropout_rate
+            self.attn_dropout_rate = attn_dropout_rate
+            self.qkv_weight = self.create_parameter(
+                [3, num_heads, self.head_dim, embed_dim],
+                default_initializer=I.XavierNormal(),
+            )
+            self.qkv_bias = self.create_parameter([3 * embed_dim], is_bias=True)
+            self.linear_weight = self.create_parameter(
+                [embed_dim, embed_dim], default_initializer=I.XavierNormal()
+            )
+            self.linear_bias = self.create_parameter([embed_dim], is_bias=True)
+            self.pre_ln_scale = self.create_parameter(
+                [embed_dim], default_initializer=I.Constant(1.0)
+            )
+            self.pre_ln_bias = self.create_parameter([embed_dim], is_bias=True)
+            self.ln_scale = self.create_parameter(
+                [embed_dim], default_initializer=I.Constant(1.0)
+            )
+            self.ln_bias = self.create_parameter([embed_dim], is_bias=True)
+
+        def forward(self, x, attn_mask=None, cache=None):
+            return nn.functional.fused_multi_head_attention(
+                x, self.qkv_weight, self.linear_weight,
+                pre_layer_norm=self.normalize_before,
+                pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+                ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+                qkv_bias=self.qkv_bias, linear_bias=self.linear_bias,
+                attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+                attn_dropout_rate=self.attn_dropout_rate,
+                pre_ln_epsilon=self.epsilon, ln_epsilon=self.epsilon,
+                training=self.training,
+            )
+
+    class FusedFeedForward(Layer):
+        """Reference: paddle.incubate.nn.FusedFeedForward."""
+
+        def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                     activation="relu", act_dropout_rate=None,
+                     normalize_before=False, epsilon=1e-5, **k):
+            super().__init__()
+            self.epsilon = epsilon
+            self.normalize_before = normalize_before
+            self.activation = activation
+            self.dropout_rate = dropout_rate
+            self.act_dropout_rate = (
+                dropout_rate if act_dropout_rate is None else act_dropout_rate
+            )
+            self.linear1_weight = self.create_parameter(
+                [d_model, dim_feedforward], default_initializer=I.XavierNormal()
+            )
+            self.linear1_bias = self.create_parameter([dim_feedforward], is_bias=True)
+            self.linear2_weight = self.create_parameter(
+                [dim_feedforward, d_model], default_initializer=I.XavierNormal()
+            )
+            self.linear2_bias = self.create_parameter([d_model], is_bias=True)
+            self.ln1_scale = self.create_parameter(
+                [d_model], default_initializer=I.Constant(1.0)
+            )
+            self.ln1_bias = self.create_parameter([d_model], is_bias=True)
+            self.ln2_scale = self.create_parameter(
+                [d_model], default_initializer=I.Constant(1.0)
+            )
+            self.ln2_bias = self.create_parameter([d_model], is_bias=True)
+
+        def forward(self, x):
+            return nn.functional.fused_feedforward(
+                x, self.linear1_weight, self.linear2_weight,
+                linear1_bias=self.linear1_bias, linear2_bias=self.linear2_bias,
+                ln1_scale=self.ln1_scale, ln1_bias=self.ln1_bias,
+                ln2_scale=self.ln2_scale, ln2_bias=self.ln2_bias,
+                dropout1_rate=self.act_dropout_rate,
+                dropout2_rate=self.dropout_rate,
+                activation=self.activation,
+                ln1_epsilon=self.epsilon, ln2_epsilon=self.epsilon,
+                pre_layer_norm=self.normalize_before,
+                training=self.training,
+            )
+
+    nn.FusedMultiHeadAttention = FusedMultiHeadAttention
+    nn.FusedFeedForward = FusedFeedForward
+
+
+_define_fused_layers()

@@ -265,3 +265,86 @@ class TestRound4FunctionalLayers:
         lab = t((np.random.RandomState(10).rand(2, 6) > 0.5).astype(np.float32))
         loss = nn.MultiLabelSoftMarginLoss()(x, lab)
         assert np.isfinite(float(loss.numpy()))
+
+
+class TestIncubateFused:
+    """incubate.nn fused attention/FFN blocks vs explicit composition
+    (reference: paddle/phi/kernels/fusion fused_attention / fused_ffn)."""
+
+    def test_fused_mha_matches_manual(self):
+        from paddle_tpu import incubate
+
+        rng = np.random.RandomState(0)
+        b, s, d, h = 2, 8, 16, 4
+        hd = d // h
+        x = rng.rand(b, s, d).astype(np.float32)
+        qkv_w = rng.rand(3, h, hd, d).astype(np.float32) * 0.2
+        qkv_b = rng.rand(3 * d).astype(np.float32) * 0.1
+        lin_w = rng.rand(d, d).astype(np.float32) * 0.2
+        out = incubate.nn.functional.fused_multi_head_attention(
+            t(x), t(qkv_w), t(lin_w), qkv_bias=t(qkv_b),
+            dropout_rate=0.0, attn_dropout_rate=0.0,
+            ln_scale=t(np.ones(d, np.float32)), ln_bias=t(np.zeros(d, np.float32)),
+        ).numpy()
+        qkv = np.einsum("bsd,thed->bsthe", x, qkv_w) + qkv_b.reshape(3, h, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        att = F.scaled_dot_product_attention(
+            t(np.ascontiguousarray(q)), t(np.ascontiguousarray(k)),
+            t(np.ascontiguousarray(v)),
+        ).numpy().reshape(b, s, d)
+        res = x + att @ lin_w
+        mu = res.mean(-1, keepdims=True)
+        var = res.var(-1, keepdims=True)
+        ref = (res - mu) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_fused_layers_train(self):
+        from paddle_tpu import incubate
+
+        paddle.seed(0)
+        mha = incubate.nn.FusedMultiHeadAttention(16, 4, dropout_rate=0.0, attn_dropout_rate=0.0)
+        ffn = incubate.nn.FusedFeedForward(16, 32, dropout_rate=0.0)
+        opt = paddle.optimizer.Adam(
+            learning_rate=1e-3,
+            parameters=list(mha.parameters()) + list(ffn.parameters()),
+        )
+        x = t(np.random.RandomState(1).rand(2, 8, 16).astype(np.float32))
+        y = t(np.random.RandomState(2).rand(2, 8, 16).astype(np.float32))
+        losses = []
+        for _ in range(5):
+            loss = ((ffn(mha(x)) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+
+def test_fused_mha_cache_and_2d_layout():
+    from paddle_tpu import incubate
+
+    rng = np.random.RandomState(3)
+    b, d, h = 1, 8, 2
+    hd = d // h
+    x = rng.rand(b, 1, d).astype(np.float32)
+    qkv_w = rng.rand(3, h, hd, d).astype(np.float32) * 0.2
+    lin_w = rng.rand(d, d).astype(np.float32) * 0.2
+    ones, zeros = t(np.ones(d, np.float32)), t(np.zeros(d, np.float32))
+    kw = dict(dropout_rate=0.0, attn_dropout_rate=0.0, ln_scale=ones, ln_bias=zeros)
+
+    # decode cache contract: (out, cache) returned, cache grows [2,b,h,s,hd]
+    cache = t(np.zeros((2, b, h, 0, hd), np.float32))
+    _, cache = incubate.nn.functional.fused_multi_head_attention(
+        t(x), t(qkv_w), t(lin_w), cache_kv=cache, **kw)
+    assert cache.shape == [2, b, h, 1, hd]
+    _, cache = incubate.nn.functional.fused_multi_head_attention(
+        t(x), t(qkv_w), t(lin_w), cache_kv=cache, **kw)
+    assert cache.shape == [2, b, h, 2, hd]
+
+    # transpose_qkv_wb 2D weight layout must equal the 4D layout exactly
+    w2d = np.transpose(qkv_w.reshape(3 * d, d), (1, 0)).copy()
+    o4 = incubate.nn.functional.fused_multi_head_attention(
+        t(x), t(qkv_w), t(lin_w), **kw).numpy()
+    o2 = incubate.nn.functional.fused_multi_head_attention(
+        t(x), t(w2d), t(lin_w), transpose_qkv_wb=True, num_heads=h, **kw).numpy()
+    np.testing.assert_allclose(o2, o4, rtol=1e-6)

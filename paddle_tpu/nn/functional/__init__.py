@@ -789,15 +789,27 @@ def batch_norm(
             # way.  (A data-derived shift would be exact from step 0 but
             # forces XLA to materialize the shifted activations — measured
             # ~10% off ResNet50 step time.)
-            a32 = a.astype(jnp.float32)
+            #
+            # Channels-last inputs reduce over a [rows, C] VIEW: XLA's
+            # row-major column reduction is ~10x faster than the
+            # multi-axis-keep-minor form on TPU (measured 80 -> 7 ms
+            # standalone on [256,56,56,256]).
+            if ch_axis == a.ndim - 1:
+                a32 = a.reshape(-1, a.shape[-1]).astype(jnp.float32)
+                red = (0,)
+                kshape = (1, a.shape[-1])
+            else:
+                a32 = a.astype(jnp.float32)
+                red = reduce_axes
+                kshape = shape
             k = (
-                jax.lax.stop_gradient(k_in[0].astype(jnp.float32)).reshape(shape)
+                jax.lax.stop_gradient(k_in[0].astype(jnp.float32)).reshape(kshape)
                 if k_in
-                else jnp.zeros(shape, jnp.float32)
+                else jnp.zeros(kshape, jnp.float32)
             )
             d = a32 - k
-            m = jnp.mean(d, axis=reduce_axes)
-            ms = jnp.mean(d * d, axis=reduce_axes)
+            m = jnp.mean(d, axis=red)
+            ms = jnp.mean(d * d, axis=red)
             return m + k.reshape(m.shape), jnp.maximum(ms - m * m, 0.0)
 
         mean, var = apply(_stats, stats_ins, name="bn_stats", multi=True)

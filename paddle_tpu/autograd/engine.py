@@ -211,7 +211,15 @@ _post_backward_hooks = weakref.WeakKeyDictionary()
 
 
 def register_post_backward_hook(owner, fn):
-    _post_backward_hooks[owner] = fn
+    # a bound method of `owner` stored as the VALUE would strongly reference
+    # the key and pin the entry forever (the WeakKeyDictionary caveat) —
+    # store it as a WeakMethod and resolve at call time instead
+    import inspect
+
+    if inspect.ismethod(fn):
+        _post_backward_hooks[owner] = weakref.WeakMethod(fn)
+    else:
+        _post_backward_hooks[owner] = fn
 
 
 def run_backward(
@@ -323,5 +331,9 @@ def run_backward(
             if not create_graph:
                 out[tid].stop_gradient = True
     for cb in list(_post_backward_hooks.values()):
+        if isinstance(cb, weakref.WeakMethod):
+            cb = cb()
+            if cb is None:
+                continue
         cb()
     return out

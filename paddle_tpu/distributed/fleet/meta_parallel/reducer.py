@@ -41,9 +41,16 @@ class Reducer:
         # bucket assignment: reverse order, capped by bytes
         cap = int(bucket_cap_mb * 1024 * 1024)
         self._buckets = []
-        cur, cur_bytes = [], 0
+        # buckets are homogeneous in dtype (reference reducer groups per
+        # dtype): the fused flush concats grads, and a mixed bucket would
+        # silently promote every member to the widest dtype
+        cur, cur_bytes, cur_dtype = [], 0, None
         for p in reversed(self._params):
             nbytes = int(np.prod(p.shape or [1])) * p.element_size()
+            if cur and p.dtype != cur_dtype:
+                self._buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur_dtype = p.dtype
             cur.append(p)
             cur_bytes += nbytes
             if cur_bytes >= cap:

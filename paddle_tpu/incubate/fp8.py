@@ -23,6 +23,17 @@ def _amax_to_scale(amax, fmax):
     return jnp.where(amax > 0, fmax / amax, 1.0).astype(jnp.float32)
 
 
+def _unbroadcast(x, shape):
+    """Sum a batched-matmul gradient back down to an operand's shape."""
+    extra = x.ndim - len(shape)
+    if extra > 0:
+        x = x.sum(axis=tuple(range(extra)))
+    axes = tuple(i for i, (xs, s) in enumerate(zip(x.shape, shape)) if s == 1 and xs != 1)
+    if axes:
+        x = x.sum(axis=axes, keepdims=True)
+    return x
+
+
 def quantize_fp8(x, dtype="e4m3", scale=None):
     """Quantize to fp8 with a per-tensor scale.  Returns (x_fp8, scale)
     where `x ≈ x_fp8.astype(f32) / scale`."""
@@ -82,9 +93,21 @@ def fp8_matmul(x, w, x_scale=None, w_scale=None, out_dtype="bfloat16"):
             sg = _amax_to_scale(jnp.max(jnp.abs(g32)), E5M2_MAX)
             qg = jnp.clip(g32 * sg, -E5M2_MAX, E5M2_MAX).astype(jnp.float8_e5m2)
             gq = qg.astype(jnp.float32) / sg
-            da = jnp.matmul(gq, b.astype(jnp.float32).T).astype(a.dtype)
-            db = jnp.matmul(a.astype(jnp.float32).T, gq).astype(b.dtype)
-            return da, db
+            a32 = a.astype(jnp.float32)
+            b32 = b.astype(jnp.float32)
+            if b.ndim == 2 and a.ndim >= 2:
+                # the F.linear shape: [..., K] @ [K, N] — contract every
+                # leading dim of the activation into the weight grad
+                da = jnp.matmul(gq, b32.T)
+                db = jnp.einsum("...k,...n->kn", a32, gq)
+            else:
+                da = _unbroadcast(
+                    jnp.matmul(gq, jnp.swapaxes(b32, -1, -2)), a.shape
+                )
+                db = _unbroadcast(
+                    jnp.matmul(jnp.swapaxes(a32, -1, -2), gq), b.shape
+                )
+            return da.astype(a.dtype), db.astype(b.dtype)
 
         _mm.defvjp(fwd, bwd)
         return _mm(a, b)

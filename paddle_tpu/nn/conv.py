@@ -120,4 +120,5 @@ class Conv3DTranspose(_ConvNd):
         return F.conv3d_transpose(
             x, self.weight, self.bias, self._stride, self._padding,
             self._output_padding, self._groups, self._dilation, self._data_format,
+            output_size,
         )

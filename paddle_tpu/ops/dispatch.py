@@ -141,7 +141,15 @@ def _dispatch_salt():
         _last_salt_mesh = mesh
     amp = _core.active_amp()
     amp_key = (amp.enabled, amp.level, amp.dtype) if amp is not None else None
-    return (mesh, amp_key, _core.flag("FLAGS_check_nan_inf"))
+    # behavior-controlling module globals op bodies read at trace time —
+    # without them a flag flip after a same-shape call would silently return
+    # the stale cached executable (e.g. a test forcing the Pallas interpret
+    # path getting the previously-compiled XLA path)
+    import sys
+
+    fa = sys.modules.get("paddle_tpu.ops.flash_attention")
+    fa_key = getattr(fa, "_FORCE_INTERPRET", None) if fa is not None else None
+    return (mesh, amp_key, _core.flag("FLAGS_check_nan_inf"), fa_key)
 
 
 def _cache_get(key, builder):

@@ -213,7 +213,11 @@ class Optimizer:
             sd["LR_Scheduler"] = self._learning_rate.state_dict()
         return sd
 
-    def set_state_dict(self, state):
+    def set_state_dict(self, state, strict=True):
+        """Restore accumulator state.  `strict=True` (default) raises on
+        entries that match no parameter — renamed/re-indexed params must not
+        silently lose optimizer state (SURVEY §5.4 resume contract); pass
+        strict=False for the old warn-and-ignore behavior."""
         import warnings
 
         self._step_count = state.get("_step_count", 0)
@@ -259,10 +263,13 @@ class Optimizer:
                 _core.unmark_born(t)
                 self._accumulators[key] = t
         if unmatched:
-            warnings.warn(
+            msg = (
                 f"optimizer.set_state_dict: {len(unmatched)} state entries did "
-                f"not match any parameter name and were ignored: {unmatched[:5]}"
+                f"not match any parameter name: {unmatched[:5]}"
             )
+            if strict:
+                raise ValueError(msg + " (pass strict=False to ignore)")
+            warnings.warn(msg + " — ignored (strict=False)")
 
 
 class SGD(Optimizer):

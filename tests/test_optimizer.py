@@ -92,6 +92,9 @@ class TestOptimizers:
         np.testing.assert_allclose(master.numpy(), [1.0 - 10e-3], rtol=1e-4)
 
     def test_state_dict_roundtrip(self):
+        # auto-named tensors get fresh names per instance — the strict
+        # default must catch that (silently losing moments is the failure
+        # mode); strict=False restores what it can
         w = t(np.array([1.0]), rg=True)
         opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
         (w * 2).sum().backward()
@@ -101,7 +104,10 @@ class TestOptimizers:
         opt2 = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w2])
         (w2 * 2).sum().backward()
         opt2.step()
-        opt2.set_state_dict(sd)
+        with pytest.raises(ValueError, match="did not match"):
+            opt2.set_state_dict(sd)
+        with pytest.warns(UserWarning, match="did not match"):
+            opt2.set_state_dict(sd, strict=False)
         assert opt2._step_count == opt._step_count
 
     def test_state_dict_restores_moments_across_param_objects(self):
@@ -135,13 +141,31 @@ class TestOptimizers:
             sd["resume_w_beta1_pow"].numpy(),
         )
 
-    def test_set_state_dict_warns_on_unmatched(self):
+    def test_set_state_dict_strict_raises_on_unmatched(self):
         from paddle_tpu.tensor import Parameter
 
         w = Parameter(np.array([1.0], np.float32), name="known_w")
         opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
-        with pytest.warns(UserWarning, match="did not match"):
+        with pytest.raises(ValueError, match="did not match"):
             opt.set_state_dict({"ghost_param_moment1": np.zeros(1), "_step_count": 1})
+        with pytest.warns(UserWarning, match="did not match"):
+            opt.set_state_dict(
+                {"ghost_param_moment1": np.zeros(1), "_step_count": 1},
+                strict=False,
+            )
+
+    def test_roundtrip_exact_match_under_strict(self):
+        from paddle_tpu.tensor import Parameter
+
+        w = Parameter(np.array([1.0], np.float32), name="strict_w")
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+        (w * 2).sum().backward()
+        opt.step()
+        sd = opt.state_dict()
+        w2 = Parameter(np.array([1.0], np.float32), name="strict_w")
+        opt2 = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w2])
+        opt2.set_state_dict(sd)  # strict default: must not raise
+        assert opt2._step_count == opt._step_count
 
 
 class TestLRSchedulers:

@@ -101,6 +101,23 @@ class TestFP8:
         ga_ref = np.ones((8, 4), np.float32) @ np.asarray(b.numpy()).T
         assert np.abs(a.grad.numpy() - ga_ref).max() / np.abs(ga_ref).max() < 0.1
 
+    def test_fp8_matmul_grad_batched_3d(self):
+        # linear_fp8 on [B, S, D] activations — the normal F.linear shape;
+        # the weight grad must contract over ALL leading dims
+        from paddle_tpu.incubate import fp8
+
+        rng = np.random.RandomState(4)
+        an = rng.rand(2, 5, 16).astype(np.float32) - 0.5
+        bn = rng.rand(16, 4).astype(np.float32) - 0.5
+        a = t(an, rg=True)
+        b = t(bn, rg=True)
+        out = fp8.fp8_matmul(a, b)
+        out.astype("float32").sum().backward()
+        assert tuple(a.grad.shape) == (2, 5, 16)
+        assert tuple(b.grad.shape) == (16, 4)
+        gb_ref = np.einsum("bsk,bsn->kn", an, np.ones((2, 5, 4), np.float32))
+        assert np.abs(b.grad.numpy() - gb_ref).max() / np.abs(gb_ref).max() < 0.1
+
     def test_linear_fp8_functional(self):
         import paddle_tpu.nn.functional as F
 

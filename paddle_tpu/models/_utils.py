@@ -1,4 +1,6 @@
-"""Shared model helpers."""
+"""Shared model helpers: loss plumbing and the compiled generation loops
+(greedy / sampling / beam search — reference: PaddleNLP generation_utils,
+SURVEY §2.3 ecosystem; the static-KV decode design is SURVEY §2.1 L8)."""
 
 from __future__ import annotations
 
@@ -23,59 +25,117 @@ def sequence_ce(model, logits, labels, ignore_index=-100):
     return F.cross_entropy(logits.reshape([-1, vocab]), flat, ignore_index=ignore_index)
 
 
-def _filter_logits(logits, top_k, top_p):
-    """top-k / nucleus filtering on [b, V] logits (reference:
-    generation_utils TopKProcess/TopPProcess) — eager ops on a small array."""
+def _filter_logits_array(lg, top_k, top_p):
+    """top-k / nucleus filtering on a [b, V] logits ARRAY — shared by the
+    eager helper below and the compiled sampling step (reference:
+    generation_utils TopKProcess/TopPProcess)."""
+    import jax
     import jax.numpy as jnp
 
+    out = lg
+    if top_k and top_k > 0:
+        kth = jnp.sort(out, axis=-1)[:, -int(top_k)][:, None]
+        out = jnp.where(out < kth, -1e30, out)
+    if top_p is not None and top_p < 1.0:
+        sort_idx = jnp.argsort(out, axis=-1)[:, ::-1]
+        sorted_lg = jnp.take_along_axis(out, sort_idx, -1)
+        probs = jax.nn.softmax(sorted_lg, axis=-1)
+        cum = jnp.cumsum(probs, -1)
+        # keep tokens until cumulative prob exceeds top_p (always >= 1)
+        keep_sorted = cum - probs < top_p
+        keep = jnp.zeros_like(keep_sorted).at[
+            jnp.arange(out.shape[0])[:, None], sort_idx
+        ].set(keep_sorted)
+        out = jnp.where(keep, out, -1e30)
+    return out
+
+
+def _filter_logits(logits, top_k, top_p):
+    """Tensor-level top-k / nucleus filtering on [b, V] logits."""
     from ..ops.dispatch import apply, coerce
 
-    logits = coerce(logits)
+    return apply(
+        lambda lg: _filter_logits_array(lg, top_k, top_p),
+        [coerce(logits)],
+        name="sample_filter",
+    )
 
-    def f(lg):
-        out = lg
-        if top_k and top_k > 0:
-            kth = jnp.sort(out, axis=-1)[:, -int(top_k)][:, None]
-            out = jnp.where(out < kth, -1e30, out)
-        if top_p is not None and top_p < 1.0:
-            sort_idx = jnp.argsort(out, axis=-1)[:, ::-1]
-            sorted_lg = jnp.take_along_axis(out, sort_idx, -1)
-            probs = jax_softmax(sorted_lg)
-            cum = jnp.cumsum(probs, -1)
-            # keep tokens until cumulative prob exceeds top_p (always >= 1)
-            keep_sorted = cum - probs < top_p
-            keep = jnp.zeros_like(keep_sorted).at[
-                jnp.arange(out.shape[0])[:, None], sort_idx
-            ].set(keep_sorted)
-            out = jnp.where(keep, out, -1e30)
-        return out
 
+def _sample_from_logits(logits, key, temp, top_k, top_p):
+    """Filter + categorical draw, traced INTO the compiled decode step so
+    sampled generation stays one executable dispatch per token (round-4
+    verdict: per-token eager filtering between compiled steps was the
+    serving bottleneck).  key: uint32[2] PRNG state threaded through."""
     import jax
+    import jax.numpy as jnp
 
-    def jax_softmax(x):
-        return jax.nn.softmax(x, axis=-1)
+    from ..ops.dispatch import apply
 
-    return apply(f, [logits], name="sample_filter")
+    def f(lg, ky, tp):
+        lg = _filter_logits_array(lg.astype(jnp.float32) / tp, top_k, top_p)
+        ky, sub = jax.random.split(ky)
+        nxt = jax.random.categorical(sub, lg, axis=-1)
+        return nxt[:, None], ky
+
+    return apply(f, [logits, key, temp], multi=True, name="sample_from_logits")
 
 
-def compiled_generate(model, input_ids, max_new_tokens, temperature, forward_step, kv_heads,
-                      top_k=0, top_p=1.0):
-    """Shared compiled static-KV generation loop (reference: the inference
-    runtime's flash-decode path, SURVEY §2.1 L8) used by Llama and GPT.
+def _gather_rows(t, rows):
+    """t[rows] along axis 0 (beam cache/state reorder)."""
+    from ..ops.dispatch import apply
 
-    forward_step(toks, caches, pos) -> last-token logits.  Caches are
-    preallocated StaticKVCache buffers in the model's parameter dtype
-    (bf16 under AMP-O2 decorate); prefill/decode each compile ONCE per
-    (batch, cache bucket, sampling mode) and the greedy hot loop is a
-    single executable dispatch per token.
-    """
-    from .. import jit, no_grad, to_tensor
+    return apply(lambda a, r: a[r], [t, rows], name="beam_gather")
+
+
+def _ensure_gen_state(model, b, cache_len, token_dtype, kv_heads):
+    """(Re)build the static KV caches + compiled-fn registry when the
+    generation geometry changes.  Returns (caches, fns dict)."""
     from .llama import StaticKVCache
 
     cfg = model.config
+    key = (b, cache_len, str(token_dtype))
+    if getattr(model, "_gen_cache_key", None) != key:
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        cache_dtype = model.lm_head.weight.dtype  # bf16 under AMP-O2 decorate
+        caches = [
+            StaticKVCache(b, cache_len, kv_heads, head_dim, cache_dtype)
+            for _ in range(cfg.num_hidden_layers)
+        ]
+        model._gen_cache_key = key
+        model._gen_caches, model._gen_fns = caches, {}
+    return model._gen_caches, model._gen_fns
+
+
+def compiled_generate(model, input_ids, max_new_tokens, temperature, forward_step, kv_heads,
+                      top_k=0, top_p=1.0, decode_strategy=None, num_beams=1, seed=None,
+                      eos_token_id=None, length_penalty=0.0):
+    """Shared compiled static-KV generation loop used by Llama and GPT.
+
+    forward_step(toks, caches, pos) -> last-token logits.  Caches are
+    preallocated StaticKVCache buffers in the model's parameter dtype
+    (bf16 under AMP-O2 decorate); every strategy — greedy, sampling, beam —
+    runs as ONE executable dispatch per token: sampling draws inside the
+    compiled step with a threaded PRNG key, beam search reorders caches and
+    its sequence buffer inside the step.
+    """
+    import jax
+
+    from .. import jit, no_grad, to_tensor
+
+    cfg = model.config
+    if decode_strategy is None:
+        decode_strategy = (
+            "beam_search" if num_beams > 1
+            else ("sampling" if temperature > 0 else "greedy_search")
+        )
+    if decode_strategy == "beam_search" and num_beams <= 1:
+        raise ValueError("beam_search requires num_beams > 1")
+    if decode_strategy == "sampling" and temperature <= 0:
+        raise ValueError(
+            "decode_strategy='sampling' requires temperature > 0 "
+            "(use greedy_search for deterministic decoding)"
+        )
     b, s0 = input_ids.shape[0], input_ids.shape[1]
-    if max_new_tokens <= 0:
-        return input_ids
     # generation is inference: force eval so dropout never bakes into the
     # cached decode executables (they are traced once and reused across
     # later mode switches)
@@ -94,55 +154,159 @@ def compiled_generate(model, input_ids, max_new_tokens, temperature, forward_ste
             "max_position_embeddings %d; output truncated to %d new tokens",
             s0, max_new_tokens, cfg.max_position_embeddings, max(cache_len - s0, 0),
         )
-
-    token_dtype = input_ids.dtype
-    key = (b, cache_len, str(token_dtype))
-    if getattr(model, "_gen_cache_key", None) != key:
-        head_dim = cfg.hidden_size // cfg.num_attention_heads
-        cache_dtype = model.lm_head.weight.dtype  # bf16 under AMP-O2 decorate
-        caches = [
-            StaticKVCache(b, cache_len, kv_heads, head_dim, cache_dtype)
-            for _ in range(cfg.num_hidden_layers)
-        ]
-
-        def _step(toks, pos, greedy):
-            logits = forward_step(toks, caches, pos)
-            new_pos = pos + toks.shape[1]
-            if greedy:
-                return ops.argmax(logits, axis=-1, keepdim=True).astype(token_dtype), new_pos
-            return logits, new_pos
-
-        fns = {
-            "prefill_greedy": jit.to_static(lambda t, p: _step(t, p, True)),
-            "decode_greedy": jit.to_static(lambda t, p: _step(t, p, True)),
-            "prefill_logits": jit.to_static(lambda t, p: _step(t, p, False)),
-            "decode_logits": jit.to_static(lambda t, p: _step(t, p, False)),
-        }
-        model._gen_cache_key = key
-        model._gen_caches, model._gen_fns = caches, fns
-    fns = model._gen_fns
-
-    with no_grad():
-        pos0 = to_tensor(np.int32(0))
-        pieces = [input_ids]
-        if temperature <= 0:
-            nxt, pos = fns["prefill_greedy"](input_ids, pos0)
-            pieces.append(nxt)
-            for i in range(1, max_new_tokens):
-                if s0 + i >= cache_len:
-                    break
-                nxt, pos = fns["decode_greedy"](nxt, pos)
-                pieces.append(nxt)
-        else:
-            logits, pos = fns["prefill_logits"](input_ids, pos0)
-            for i in range(max_new_tokens):
-                filtered = _filter_logits(logits / temperature, top_k, top_p)
-                probs = F.softmax(filtered, axis=-1)
-                nxt = ops.multinomial(probs, 1).astype(token_dtype)
-                pieces.append(nxt)
-                if i + 1 >= max_new_tokens or s0 + i + 1 >= cache_len:
-                    break
-                logits, pos = fns["decode_logits"](nxt, pos)
+    max_new_tokens = min(max_new_tokens, cache_len - s0)
+    if max_new_tokens <= 0:
+        # over-long prompt (or zero requested): nothing can be generated
         if was_training:
             model.train()
-        return ops.concat(pieces, axis=1)
+        return input_ids
+    token_dtype = input_ids.dtype
+
+    nb = num_beams if decode_strategy == "beam_search" else 1
+    B = b * nb
+    caches, fns = _ensure_gen_state(model, B, cache_len, token_dtype, kv_heads)
+
+    def _get(name, builder):
+        if name not in fns:
+            fns[name] = jit.to_static(builder)
+        return fns[name]
+
+    def _greedy_step(toks, pos):
+        logits = forward_step(toks, caches, pos)
+        nxt = ops.argmax(logits, axis=-1, keepdim=True).astype(token_dtype)
+        return nxt, pos + toks.shape[1]
+
+    try:
+        with no_grad():
+            pos0 = to_tensor(np.int32(0))
+            if decode_strategy == "greedy_search":
+                step = _get("greedy", _greedy_step)
+                pieces = [input_ids]
+                nxt, pos = step(input_ids, pos0)
+                pieces.append(nxt)
+                for _ in range(1, max_new_tokens):
+                    nxt, pos = step(nxt, pos)
+                    pieces.append(nxt)
+                return ops.concat(pieces, axis=1)
+
+            if decode_strategy == "sampling":
+                def _sample_step(toks, pos, key, temp):
+                    logits = forward_step(toks, caches, pos)
+                    nxt, key = _sample_from_logits(logits, key, temp, top_k, top_p)
+                    return nxt.astype(token_dtype), pos + toks.shape[1], key
+
+                step = _get(("sample", top_k, top_p), _sample_step)
+                if seed is None:
+                    seed = int(np.random.randint(0, 2**31 - 1))
+                key = to_tensor(np.asarray(jax.random.PRNGKey(seed)))
+                temp = to_tensor(np.float32(temperature))
+                pieces = [input_ids]
+                nxt, pos, key = step(input_ids, pos0, key, temp)
+                pieces.append(nxt)
+                for _ in range(1, max_new_tokens):
+                    nxt, pos, key = step(nxt, pos, key, temp)
+                    pieces.append(nxt)
+                return ops.concat(pieces, axis=1)
+
+            # ---- beam search ------------------------------------------------
+            return _beam_search(
+                model, input_ids, max_new_tokens, forward_step, caches, _get,
+                nb, s0, token_dtype, eos_token_id, length_penalty, pos0,
+            )
+    finally:
+        if was_training:
+            model.train()
+
+
+def _beam_search(model, input_ids, max_new_tokens, forward_step, caches, _get,
+                 nb, s0, token_dtype, eos_token_id, length_penalty, pos0):
+    """Length-normalized beam search (reference: PaddleNLP generation_utils
+    BeamSearchScorer).  The whole per-token step — forward, top-(nb) over
+    nb*V candidates, cache reorder, sequence-buffer reorder+append — is one
+    compiled dispatch; only the optional all-done early-exit check syncs."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .. import to_tensor
+    from ..ops.dispatch import apply
+
+    cfg = model.config
+    b = input_ids.shape[0]
+    B = b * nb
+    V = cfg.vocab_size
+    eos = eos_token_id
+
+    def _beam_step(toks, pos, ti, scores, done, seqs):
+        # ti: step counter (the seqs column this step's token lands in) —
+        # threaded as DATA so one cached executable serves every prompt
+        # length (a closure constant would bake the first call's s0 in)
+        s = toks.shape[1]
+        logits = forward_step(toks, caches, pos)  # [B, V]
+
+        def f(lg, sc, dn, sq_, t_):
+            logp = jax.nn.log_softmax(lg.astype(jnp.float32), -1)  # [B, V]
+            if eos is not None:
+                # finished beams continue only with eos at zero added score
+                eos_row = jnp.where(
+                    jnp.arange(lg.shape[1])[None, :] == eos, 0.0, -jnp.inf
+                ).astype(jnp.float32)
+                logp = jnp.where(dn.reshape(B, 1), eos_row, logp)
+            total = sc.reshape(B, 1) + logp
+            top_v, top_i = lax.top_k(total.reshape(b, nb * lg.shape[1]), nb)
+            parent = top_i // lg.shape[1]  # [b, nb], beam index within batch
+            token = (top_i % lg.shape[1]).astype(jnp.int32)
+            rows = (jnp.arange(b)[:, None] * nb + parent).reshape(-1)  # [B]
+            new_seqs = lax.dynamic_update_slice_in_dim(
+                sq_[rows], token.reshape(B, 1), t_, 1
+            )
+            new_done = dn.reshape(b, nb)[jnp.arange(b)[:, None], parent]
+            if eos is not None:
+                new_done = new_done | (token == eos)
+            return token.reshape(B, 1), top_v, new_done, new_seqs, rows
+
+        token, new_scores, new_done, new_seqs, rows = apply(
+            f, [logits, scores, done, seqs, ti], multi=True, name="beam_step"
+        )
+        for c in caches:
+            c.k._data = _gather_rows(c.k, rows)._data
+            c.v._data = _gather_rows(c.v, rows)._data
+        return (
+            token.astype(token_dtype), pos + s, ti + 1,
+            new_scores, new_done, new_seqs,
+        )
+
+    step = _get(("beam", nb, eos), _beam_step)
+
+    toks = ops.repeat_interleave(input_ids, nb, axis=0)  # [B, s0]
+    scores = to_tensor(
+        np.tile(np.array([0.0] + [-1e9] * (nb - 1), np.float32), (b, 1))
+    )
+    done = to_tensor(np.zeros((b, nb), bool))
+    seqs = to_tensor(np.zeros((B, max_new_tokens), np.int32))
+    ti0 = to_tensor(np.int32(0))
+
+    nxt, pos, ti, scores, done, seqs = step(toks, pos0, ti0, scores, done, seqs)
+    steps = 1
+    for _ in range(1, max_new_tokens):
+        if eos is not None and bool(done.numpy().all()):
+            break
+        nxt, pos, ti, scores, done, seqs = step(nxt, pos, ti, scores, done, seqs)
+        steps += 1
+
+    # host-side finalization: length-normalize and pick the best beam
+    seqs_np = seqs.numpy().reshape(b, nb, max_new_tokens)[:, :, :steps]
+    scores_np = scores.numpy()  # [b, nb]
+    if eos is not None:
+        is_eos = seqs_np == eos
+        lengths = np.where(
+            is_eos.any(-1), is_eos.argmax(-1) + 1, steps
+        ).astype(np.float32)
+    else:
+        lengths = np.full((b, nb), float(steps), np.float32)
+    norm = scores_np / np.maximum(lengths, 1.0) ** length_penalty
+    best = norm.argmax(-1)  # [b]
+    out = np.concatenate(
+        [np.asarray(input_ids.numpy()), seqs_np[np.arange(b), best]], axis=1
+    )
+    return to_tensor(out.astype(np.asarray(input_ids.numpy()).dtype))

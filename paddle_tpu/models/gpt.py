@@ -98,13 +98,13 @@ class GPTAttention(nn.Layer):
         qkv = self.qkv_proj(x).reshape([b, s, 3, self.num_heads, self.head_dim])
         q, k, v = ops.unbind(qkv, axis=2)
         if cache is not None:
-            # compiled static-KV decode (same machinery as models/llama.py)
-            from .llama import _cache_write, _decode_mask
+            # compiled static-KV decode (same machinery as models/llama.py);
+            # validity computed in-kernel from pos — Pallas-eligible
+            from .llama import _cache_write
 
             cache.k._data = _cache_write(cache.k, k, pos)._data
             cache.v._data = _cache_write(cache.v, v, pos)._data
-            mask = _decode_mask(s, cache.max_len, pos)
-            out = F.scaled_dot_product_attention(q, cache.k, cache.v, attn_mask=mask)
+            out = F.flash_decode(q, cache.k, cache.v, pos)
         else:
             out = F.scaled_dot_product_attention(
                 q, k, v, dropout_p=self.dropout, is_causal=True, training=self.training
@@ -244,9 +244,11 @@ class GPTForCausalLM(nn.Layer):
         return logits
 
 
-    def generate(self, input_ids, max_new_tokens=16, temperature=0.0, top_k=0, top_p=1.0):
-        """Greedy/temperature decoding over the shared compiled static-KV
-        step (models/_utils.compiled_generate)."""
+    def generate(self, input_ids, max_new_tokens=16, temperature=0.0, top_k=0, top_p=1.0,
+                 decode_strategy=None, num_beams=1, seed=None, eos_token_id=None,
+                 length_penalty=0.0):
+        """Greedy / compiled-sampling / beam decoding over the shared
+        compiled static-KV step (models/_utils.compiled_generate)."""
         from ._utils import compiled_generate
 
         def forward_step(toks, caches, pos):
@@ -256,6 +258,8 @@ class GPTForCausalLM(nn.Layer):
         return compiled_generate(
             self, input_ids, max_new_tokens, temperature, forward_step,
             kv_heads=self.config.num_attention_heads, top_k=top_k, top_p=top_p,
+            decode_strategy=decode_strategy, num_beams=num_beams, seed=seed,
+            eos_token_id=eos_token_id, length_penalty=length_penalty,
         )
 
 

@@ -155,22 +155,6 @@ def _cache_write(cache_t, new_t, pos_t):
     return apply(f, [cache_t, new_t, pos_t], name="kv_cache_write")
 
 
-def _decode_mask(s, max_len, pos_t):
-    """Additive [1, 1, s, max_len] mask: query row i (absolute pos p+i) may
-    attend to cache slots j <= p+i; unwritten tail slots are masked out."""
-    import jax.numpy as jnp
-    from jax import lax
-
-    from ..ops.dispatch import apply
-
-    def f(p):
-        i = lax.broadcasted_iota(jnp.int32, (s, max_len), 0)
-        j = lax.broadcasted_iota(jnp.int32, (s, max_len), 1)
-        return jnp.where(j <= i + p, 0.0, -1e30).astype(jnp.float32)[None, None]
-
-    return apply(f, [pos_t], name="decode_mask")
-
-
 class LlamaMLP(nn.Layer):
     def __init__(self, config):
         super().__init__()
@@ -215,12 +199,14 @@ class LlamaAttention(nn.Layer):
         k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         if isinstance(cache, StaticKVCache):
-            # compiled decode path: fixed-shape cache, position as data
+            # compiled decode path: fixed-shape cache, position as data;
+            # cache validity rides the flash_decode kernel (in-kernel
+            # comparison against pos), never an additive mask — the mask
+            # was exactly what forced the XLA fallback (round-4 verdict)
             q, k = apply_rotary_pos_emb(q, k, self.rope_cos, self.rope_sin, pos)
             cache.k._data = _cache_write(cache.k, k, pos)._data
             cache.v._data = _cache_write(cache.v, v, pos)._data
-            mask = _decode_mask(s, cache.max_len, pos)
-            out = F.scaled_dot_product_attention(q, cache.k, cache.v, attn_mask=mask)
+            out = F.flash_decode(q, cache.k, cache.v, pos)
             out = out.reshape([b, s, self.num_heads * self.head_dim])
             return self.o_proj(out), cache
         offset = 0
@@ -367,10 +353,13 @@ class LlamaForCausalLM(nn.Layer):
             return loss, logits
         return logits
 
-    def generate(self, input_ids, max_new_tokens=16, temperature=0.0, top_k=0, top_p=1.0):
-        """Greedy/temperature sampling over the shared compiled static-KV
-        decode step (models/_utils.compiled_generate): one executable
-        dispatch per token after the first compile."""
+    def generate(self, input_ids, max_new_tokens=16, temperature=0.0, top_k=0, top_p=1.0,
+                 decode_strategy=None, num_beams=1, seed=None, eos_token_id=None,
+                 length_penalty=0.0):
+        """Greedy / compiled-sampling / beam search over the shared compiled
+        static-KV decode step (models/_utils.compiled_generate): one
+        executable dispatch per token for every strategy (reference:
+        PaddleNLP generation_utils decode_strategy)."""
         from ._utils import compiled_generate
 
         def forward_step(toks, caches, pos):
@@ -380,4 +369,6 @@ class LlamaForCausalLM(nn.Layer):
         return compiled_generate(
             self, input_ids, max_new_tokens, temperature, forward_step,
             kv_heads=self.config.num_key_value_heads, top_k=top_k, top_p=top_p,
+            decode_strategy=decode_strategy, num_beams=num_beams, seed=seed,
+            eos_token_id=eos_token_id, length_penalty=length_penalty,
         )

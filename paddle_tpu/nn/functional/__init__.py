@@ -1346,6 +1346,16 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
     return out, None
 
 
+def flash_decode(query, key, value, pos, scale=None):
+    """Cached static-KV attention: q [b, sq, h, d] against full cache
+    buffers k/v [b, L, kv_h, d]; `pos` (scalar int32 Tensor) is the write
+    position — validity is computed in-kernel from it, so the decode path
+    stays Pallas-eligible (no additive mask)."""
+    from ...ops.flash_attention import flash_decode as _fd
+
+    return _fd(query, key, value, pos, scale)
+
+
 # ---------------------------------------------------------------------------
 # misc
 # ---------------------------------------------------------------------------

@@ -421,6 +421,181 @@ def _pallas_flash_backward(q, k, v, g, out, lse, causal, scale, segments=None,
 
 
 # ---------------------------------------------------------------------------
+# Pallas decode kernel — q [sq small] vs a static KV cache [L], cache
+# validity expressed IN-KERNEL from the write position (passed as a scalar)
+# instead of an additive mask, so cached/serving attention never drops to
+# the XLA fallback (reference: the inference runtime's flash-decode path,
+# SURVEY §2.1 L8; round-4 verdict "flash-kernel decode attention").
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(
+    pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, scale, block_q, block_k,
+):
+    """Grid (bh blocks, q blocks, k blocks), k innermost.  Query row i of
+    q-block qi sits at absolute position pos + qi*block_q + i and may attend
+    cache slots j <= that position — which by construction covers exactly
+    the written slots, so no separate validity mask exists anywhere."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+    pos = pos_ref[0]
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # blocks entirely beyond the last valid slot contribute nothing
+    needed = k_start <= pos + q_start + block_q - 1
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[...]  # [bb, block_q, d]
+        k = k_ref[...]
+        v = v_ref[...]
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        ) * scale  # [bb, block_q, block_k]
+        q_ids = pos + q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+        m = m_scr[..., 0]
+        l = l_scr[..., 0]
+        m_new = jnp.maximum(m, s.max(-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        m_scr[...] = m_new[..., None]
+        l_scr[...] = (alpha * l + p.sum(-1))[..., None]
+        acc_scr[...] = acc_scr[...] * alpha[..., None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_scr[..., 0], 1e-30)
+        o_ref[...] = (acc_scr[...] / l_safe[..., None]).astype(o_ref.dtype)
+
+
+def _pallas_decode_forward(q, k, v, pos, scale, interpret=False):
+    """q: [bh, sq, d] (sq pre-padded to the q block); k,v: [bh, L, d] cache
+    buffers; pos: int32[1] scalar-prefetch.  Returns out [bh, sq, d]."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, sq, d = q.shape
+    L = k.shape[1]
+    block_q = sq if sq <= 256 else 128  # padded to 8/128 multiples by caller
+    block_k = _pick_block(L, 512)
+    # VMEM budget: score/prob temporaries + one K/V tile per bh row
+    per_bb = block_q * block_k * 4 * 2 + 2 * block_k * d * 2 + 4 * block_q * d * 4
+    limit = max(1, (8 * 1024 * 1024) // max(per_bb, 1))
+    bb = 1
+    for c in range(1, min(limit, bh) + 1):
+        if bh % c == 0:
+            bb = c
+    grid = (bh // bb, sq // block_q, L // block_k)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bb, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+            pl.BlockSpec((bb, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+            pl.BlockSpec((bb, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((bb, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bb, block_q, 1), jnp.float32),
+            pltpu.VMEM((bb, block_q, 1), jnp.float32),
+            pltpu.VMEM((bb, block_q, d), jnp.float32),
+        ],
+    )
+
+    def kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr):
+        _decode_kernel(
+            pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+            scale=scale, block_q=block_q, block_k=block_k,
+        )
+
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(pos, jnp.int32).reshape(1), q, k, v)
+
+
+def decode_attention_array(q, k, v, pos, scale=None):
+    """Cached-attention for the static-KV decode path.
+
+    q: [b, sq, h, d] (the fresh chunk); k,v: [b, L, kv_h, d] cache buffers
+    (every slot, written or not); pos: scalar int32 — absolute position of
+    q row 0.  Row i attends cache slots j <= pos + i.  Pallas on TPU (or
+    under interpret); a fused dense XLA path elsewhere — both take validity
+    from `pos`, never from a mask array.
+    """
+    b, sq, h, d = q.shape
+    L = k.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    qt = jnp.transpose(q, (0, 2, 1, 3))  # [b, h, sq, d]
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    hk = kt.shape[1]
+    if hk != h:
+        rep = h // hk
+        kt = jnp.repeat(kt, rep, axis=1)
+        vt = jnp.repeat(vt, rep, axis=1)
+    interpret = _FORCE_INTERPRET
+    if (_on_tpu() or interpret) and d <= 256 and L % 128 == 0:
+        # pad q rows up to the TPU sublane tile; padded rows attend slot 0+
+        # legitimately (their q_ids exceed the real rows') and are sliced off
+        sq_pad = -(-sq // 8) * 8 if sq <= 256 else -(-sq // 128) * 128
+        qf = qt.reshape(b * h, sq, d)
+        if sq_pad != sq:
+            qf = jnp.pad(qf, ((0, 0), (0, sq_pad - sq), (0, 0)))
+        out = _pallas_decode_forward(
+            qf,
+            kt.reshape(b * h, L, d),
+            vt.reshape(b * h, L, d),
+            pos,
+            scale,
+            interpret=interpret,
+        )[:, :sq]
+        return jnp.transpose(out.reshape(b, h, sq, d), (0, 2, 1, 3))
+    # dense path: one fused einsum chain, validity from pos
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt, preferred_element_type=jnp.float32) * scale
+    q_ids = pos + jax.lax.broadcasted_iota(jnp.int32, (sq, L), 0)
+    k_ids = jax.lax.broadcasted_iota(jnp.int32, (sq, L), 1)
+    s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(vt.dtype), vt, preferred_element_type=jnp.float32
+    ).astype(q.dtype)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def flash_decode(query, key, value, pos, scale=None):
+    """Tensor-level cached-decode attention (see decode_attention_array)."""
+    query, key, value, pos = coerce(query), coerce(key), coerce(value), coerce(pos)
+
+    def f(q, k, v, p):
+        return decode_attention_array(q, k, v, p, scale)
+
+    return apply(f, [query, key, value, pos], name="flash_decode")
+
+
+# ---------------------------------------------------------------------------
 # Blockwise XLA fallback (O(seq) memory via scan + checkpoint)
 # ---------------------------------------------------------------------------
 

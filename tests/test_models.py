@@ -226,8 +226,9 @@ class TestLlama:
         x = ids(2, 8)
         out = model.generate(x, max_new_tokens=6)
         assert out.shape == [2, 14]
-        assert model._gen_fns["decode_greedy"].trace_count == 1
-        assert model._gen_fns["prefill_greedy"].trace_count == 1
+        # one StaticFunction serves prefill+decode: exactly two traces
+        # (one per token-chunk shape), then zero recompiles forever
+        assert model._gen_fns["greedy"].trace_count == 2
 
         # greedy consistency: re-scoring the generated prefix with a plain
         # forward must reproduce the last generated token
@@ -237,7 +238,7 @@ class TestLlama:
 
         out2 = model.generate(x, max_new_tokens=6)
         np.testing.assert_array_equal(out.numpy(), out2.numpy())
-        assert model._gen_fns["decode_greedy"].trace_count == 1  # zero recompiles
+        assert model._gen_fns["greedy"].trace_count == 2  # zero recompiles
 
     def test_generate(self):
         cfg = LlamaConfig.tiny()
@@ -388,14 +389,14 @@ class TestGPTDecode:
         x = ids(2, 8)
         out = model.generate(x, max_new_tokens=5)
         assert out.shape == [2, 13]
-        assert model._gen_fns["decode_greedy"].trace_count == 1
+        assert model._gen_fns["greedy"].trace_count == 2
         full = model(paddle.to_tensor(out.numpy()[:, :-1].astype(np.int32)))
         np.testing.assert_array_equal(
             np.argmax(full.numpy()[:, -1], -1), out.numpy()[:, -1]
         )
         out2 = model.generate(x, max_new_tokens=5)
         np.testing.assert_array_equal(out.numpy(), out2.numpy())
-        assert model._gen_fns["decode_greedy"].trace_count == 1
+        assert model._gen_fns["greedy"].trace_count == 2
 
 
 class TestSampling:

@@ -44,6 +44,7 @@ from . import device
 from . import profiler
 from . import incubate
 from . import sparse
+from . import fft
 from . import static
 from . import inference
 from .framework.io import save, load  # noqa: F401

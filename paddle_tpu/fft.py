@@ -1,0 +1,131 @@
+"""paddle.fft — discrete Fourier transform family (reference:
+python/paddle/fft.py, which wraps phi's cuFFT/onednn FFT kernels).
+TPU-native: every transform lowers through jnp.fft onto XLA's FFT HLO,
+with the reference's axis/n/norm surface and autograd through the
+dispatch layer (XLA differentiates FFT natively).
+
+Norm conventions match the reference (and numpy): "backward" scales the
+inverse by 1/n, "ortho" scales both by 1/sqrt(n), "forward" scales the
+forward by 1/n.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .ops.dispatch import apply, coerce
+from .tensor import Tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2",
+    "fftn", "ifftn", "rfftn", "irfftn",
+    "fftshift", "ifftshift", "fftfreq", "rfftfreq",
+]
+
+_NORMS = ("backward", "ortho", "forward")
+
+
+def _check_norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(f"norm must be one of {_NORMS}, got {norm!r}")
+    return norm
+
+
+def _unary_fft(jnp_name, x, extra_kwargs, name):
+    import jax.numpy as jnp
+
+    x = coerce(x)
+    fn = getattr(jnp.fft, jnp_name)
+    return apply(lambda a: fn(a, **extra_kwargs), [x], name=name)
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    """1-D DFT along `axis` (reference: paddle.fft.fft)."""
+    return _unary_fft("fft", x, dict(n=n, axis=axis, norm=_check_norm(norm)), "fft")
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return _unary_fft("ifft", x, dict(n=n, axis=axis, norm=_check_norm(norm)), "ifft")
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    """Real-input DFT: output has n//2+1 frequencies along `axis`."""
+    return _unary_fft("rfft", x, dict(n=n, axis=axis, norm=_check_norm(norm)), "rfft")
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _unary_fft("irfft", x, dict(n=n, axis=axis, norm=_check_norm(norm)), "irfft")
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    """DFT of a Hermitian-symmetric signal -> real output."""
+    return _unary_fft("hfft", x, dict(n=n, axis=axis, norm=_check_norm(norm)), "hfft")
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _unary_fft("ihfft", x, dict(n=n, axis=axis, norm=_check_norm(norm)), "ihfft")
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _unary_fft("fft2", x, dict(s=s, axes=tuple(axes), norm=_check_norm(norm)), "fft2")
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _unary_fft("ifft2", x, dict(s=s, axes=tuple(axes), norm=_check_norm(norm)), "ifft2")
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _unary_fft("rfft2", x, dict(s=s, axes=tuple(axes), norm=_check_norm(norm)), "rfft2")
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _unary_fft("irfft2", x, dict(s=s, axes=tuple(axes), norm=_check_norm(norm)), "irfft2")
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    axes = tuple(axes) if axes is not None else None
+    return _unary_fft("fftn", x, dict(s=s, axes=axes, norm=_check_norm(norm)), "fftn")
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    axes = tuple(axes) if axes is not None else None
+    return _unary_fft("ifftn", x, dict(s=s, axes=axes, norm=_check_norm(norm)), "ifftn")
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    axes = tuple(axes) if axes is not None else None
+    return _unary_fft("rfftn", x, dict(s=s, axes=axes, norm=_check_norm(norm)), "rfftn")
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    axes = tuple(axes) if axes is not None else None
+    return _unary_fft("irfftn", x, dict(s=s, axes=axes, norm=_check_norm(norm)), "irfftn")
+
+
+def fftshift(x, axes=None, name=None):
+    """Shift the zero-frequency component to the center."""
+    import jax.numpy as jnp
+
+    axes = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+    return apply(lambda a: jnp.fft.fftshift(a, axes=axes), [coerce(x)], name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    import jax.numpy as jnp
+
+    axes = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+    return apply(lambda a: jnp.fft.ifftshift(a, axes=axes), [coerce(x)], name="ifftshift")
+
+
+def fftfreq(n, d=1.0, dtype="float32", name=None):
+    """Sample frequencies for fft output (host-computed constant)."""
+    from .framework import core as _core
+
+    return Tensor(np.fft.fftfreq(int(n), d).astype(_core.to_jax_dtype(dtype)))
+
+
+def rfftfreq(n, d=1.0, dtype="float32", name=None):
+    from .framework import core as _core
+
+    return Tensor(np.fft.rfftfreq(int(n), d).astype(_core.to_jax_dtype(dtype)))

@@ -72,8 +72,23 @@ def _sample_from_logits(logits, key, temp, top_k, top_p):
     from ..ops.dispatch import apply
 
     def f(lg, ky, tp):
-        lg = _filter_logits_array(lg.astype(jnp.float32) / tp, top_k, top_p)
+        lg = lg.astype(jnp.float32) / tp
         ky, sub = jax.random.split(ky)
+        # oversized top_k is a no-op (falls through to the generic path so
+        # candidate order — and thus the categorical draw — matches top_k=0)
+        if top_k and 0 < top_k < lg.shape[-1]:
+            # fast path: one lax.top_k over V, then filter/sample within the
+            # k candidates — the full-vocab sort+argsort+scatter of the
+            # generic filter costs ~2.5x the whole decode step at V=32k
+            vals, idx = jax.lax.top_k(lg, int(top_k))  # [b, k], descending
+            if top_p is not None and top_p < 1.0:
+                probs = jax.nn.softmax(vals, axis=-1)
+                cum = jnp.cumsum(probs, -1)
+                vals = jnp.where(cum - probs < top_p, vals, -1e30)
+            c = jax.random.categorical(sub, vals)  # [b]
+            nxt = jnp.take_along_axis(idx, c[:, None], -1)
+            return nxt, ky
+        lg = _filter_logits_array(lg, 0, top_p)
         nxt = jax.random.categorical(sub, lg, axis=-1)
         return nxt[:, None], ky
 
@@ -127,6 +142,11 @@ def compiled_generate(model, input_ids, max_new_tokens, temperature, forward_ste
         decode_strategy = (
             "beam_search" if num_beams > 1
             else ("sampling" if temperature > 0 else "greedy_search")
+        )
+    if decode_strategy not in ("greedy_search", "sampling", "beam_search"):
+        raise ValueError(
+            f"decode_strategy must be one of 'greedy_search', 'sampling', "
+            f"'beam_search'; got {decode_strategy!r}"
         )
     if decode_strategy == "beam_search" and num_beams <= 1:
         raise ValueError("beam_search requires num_beams > 1")

@@ -200,6 +200,29 @@ def coerce(x, dtype=None):
     return Tensor(x, dtype=dtype)
 
 
+class _CaptureRecorder:
+    """Records every Tensor flowing through apply() while installed —
+    static.nn.cond/while_loop run a discovery pass under one to learn which
+    outer tensors a branch/body closure captures, so those can become
+    explicit lax.cond/scan operands (and receive gradients)."""
+
+    def __init__(self):
+        self.inputs = []
+        self.created = set()
+
+    def captured(self):
+        out, seen = [], set()
+        for t in self.inputs:
+            if id(t) in self.created or id(t) in seen:
+                continue
+            seen.add(id(t))
+            out.append(t)
+        return out
+
+
+_capture_recorder = None
+
+
 def apply(fn, inputs, name=None, multi=False, outputs_stop_gradient=None):
     """Execute `fn(*arrays)` over the inputs' payloads; record autograd.
 
@@ -226,6 +249,9 @@ def apply(fn, inputs, name=None, multi=False, outputs_stop_gradient=None):
         avals = tuple((tuple(a.shape), jnp.dtype(a.dtype)) for a in arrays)
         ckey = (ckey, avals, multi, _dispatch_salt())
 
+    if _capture_recorder is not None:
+        _capture_recorder.inputs.extend(inputs)
+
     if not record:
         if ckey is not _UNHASHABLE:
             jfn = _cache_get(("fwd", ckey), lambda: jax.jit(lambda *ar: fn(*ar)))
@@ -237,6 +263,8 @@ def apply(fn, inputs, name=None, multi=False, outputs_stop_gradient=None):
         if outputs_stop_gradient is not None:
             for t, sg in zip(tensors, outputs_stop_gradient):
                 t.stop_gradient = sg
+        if _capture_recorder is not None:
+            _capture_recorder.created.update(id(t) for t in tensors)
         if _core.flag("FLAGS_check_nan_inf"):
             _check_nan_inf(name or "op", tensors)
         return tensors if multi else tensors[0]
@@ -303,6 +331,8 @@ def apply(fn, inputs, name=None, multi=False, outputs_stop_gradient=None):
         if not t.stop_gradient:
             t._grad_node = node
             t._out_index = j
+    if _capture_recorder is not None:
+        _capture_recorder.created.update(id(t) for t in tensors)
     if _core.flag("FLAGS_check_nan_inf"):
         _check_nan_inf(name or "op", tensors)
     return tensors if multi else tensors[0]

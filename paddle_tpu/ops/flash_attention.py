@@ -549,40 +549,53 @@ def decode_attention_array(q, k, v, pos, scale=None):
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     qt = jnp.transpose(q, (0, 2, 1, 3))  # [b, h, sq, d]
-    kt = jnp.transpose(k, (0, 2, 1, 3))
-    vt = jnp.transpose(v, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))  # [b, hk, L, d] — NEVER repeated:
+    vt = jnp.transpose(v, (0, 2, 1, 3))  # GQA groups share the cache as-is
     hk = kt.shape[1]
-    if hk != h:
-        rep = h // hk
-        kt = jnp.repeat(kt, rep, axis=1)
-        vt = jnp.repeat(vt, rep, axis=1)
+    rep = h // hk
     interpret = _FORCE_INTERPRET
-    if (_on_tpu() or interpret) and d <= 256 and L % 128 == 0:
+    # kernel choice by q-chunk size: single-token (and small-chunk) decode
+    # is a matvec per head — the dense XLA lowering fuses it into the
+    # surrounding program with zero launch overhead and IS the optimal
+    # flash-decode for q=1 (measured: Pallas per-layer launches cost ~30%
+    # of decode tok/s).  The Pallas kernel wins for prefill-with-cache,
+    # where it avoids materializing the [sq, L] score block.
+    if (_on_tpu() or interpret) and d <= 256 and L % 128 == 0 and sq >= 64:
         # pad q rows up to the TPU sublane tile; padded rows attend slot 0+
         # legitimately (their q_ids exceed the real rows') and are sliced off
         sq_pad = -(-sq // 8) * 8 if sq <= 256 else -(-sq // 128) * 128
-        qf = qt.reshape(b * h, sq, d)
-        if sq_pad != sq:
-            qf = jnp.pad(qf, ((0, 0), (0, sq_pad - sq), (0, 0)))
-        out = _pallas_decode_forward(
-            qf,
-            kt.reshape(b * h, L, d),
-            vt.reshape(b * h, L, d),
-            pos,
-            scale,
-            interpret=interpret,
-        )[:, :sq]
+        kf = kt.reshape(b * hk, L, d)
+        vf = vt.reshape(b * hk, L, d)
+        # one kernel call per GQA group: q heads of group r run against the
+        # UN-duplicated cache (a jnp.repeat would materialize rep copies of
+        # the whole cache per layer per step)
+        qg = qt.reshape(b, hk, rep, sq, d)
+        outs = []
+        for r in range(rep):
+            qf = qg[:, :, r].reshape(b * hk, sq, d)
+            if sq_pad != sq:
+                qf = jnp.pad(qf, ((0, 0), (0, sq_pad - sq), (0, 0)))
+            outs.append(
+                _pallas_decode_forward(qf, kf, vf, pos, scale, interpret=interpret)[
+                    :, :sq
+                ].reshape(b, hk, 1, sq, d)
+            )
+        out = outs[0] if rep == 1 else jnp.concatenate(outs, axis=2)
         return jnp.transpose(out.reshape(b, h, sq, d), (0, 2, 1, 3))
-    # dense path: one fused einsum chain, validity from pos
-    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt, preferred_element_type=jnp.float32) * scale
+    # dense path: grouped einsum chain (kv heads stay un-repeated; the GQA
+    # broadcast happens inside the contraction), validity from pos
+    q5 = qt.reshape(b, hk, rep, sq, d)
+    s = jnp.einsum(
+        "bgrqd,bgkd->bgrqk", q5, kt, preferred_element_type=jnp.float32
+    ) * scale
     q_ids = pos + jax.lax.broadcasted_iota(jnp.int32, (sq, L), 0)
     k_ids = jax.lax.broadcasted_iota(jnp.int32, (sq, L), 1)
     s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum(
-        "bhqk,bhkd->bhqd", p.astype(vt.dtype), vt, preferred_element_type=jnp.float32
+        "bgrqk,bgkd->bgrqd", p.astype(vt.dtype), vt, preferred_element_type=jnp.float32
     ).astype(q.dtype)
-    return jnp.transpose(out, (0, 2, 1, 3))
+    return jnp.transpose(out.reshape(b, h, sq, d), (0, 2, 1, 3))
 
 
 def flash_decode(query, key, value, pos, scale=None):

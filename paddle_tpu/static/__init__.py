@@ -178,6 +178,63 @@ def _tree_restore(tpl, leaves):
     return _tree_restore_jit(tpl, leaves)
 
 
+def _record_captures(run):
+    """Run `run()` abstractly (jax.eval_shape) with the dispatch
+    capture-recorder installed; returns (result, captured leaf Tensors).
+    The pass learns output structure and which outer tensors the closures
+    read WITHOUT adding any op — or any effect (prints, callbacks) — to the
+    outer program; result leaves carry abstract values usable only for
+    shape/dtype inspection."""
+    from ..ops import dispatch as _dispatch
+    from ..ops.dispatch import coerce
+
+    rec = _dispatch._CaptureRecorder()
+    box = {}
+
+    def wrapped():
+        old = _dispatch._capture_recorder
+        _dispatch._capture_recorder = rec
+        try:
+            out = run()
+        finally:
+            _dispatch._capture_recorder = old
+        box["out"] = out
+        sink = []
+        _tree_tensors(out, sink)
+        return tuple(coerce(t)._data for t in sink)
+
+    jax.eval_shape(wrapped)
+    return box["out"], rec.captured()
+
+
+def _branch_runner(fn, captured, out_check=None):
+    """Build a pure array->arrays function that re-runs the paddle-level
+    `fn` under a NESTED execute-trace substituting the captured tensors'
+    slots with the given arrays (the same mechanism jit's compiled runner
+    uses).  State writes inside go to the nested overlay and are discarded:
+    control-flow blocks are pure, as the reference requires."""
+    from ..framework import core as _core
+    from ..jit import _Trace
+    from ..ops.dispatch import coerce
+
+    def run(arrays):
+        subst = {(id(t), "data"): a for t, a in zip(captured, arrays)}
+        tr = _Trace("execute", subst=subst)
+        old = _core.set_active_trace(tr)
+        try:
+            with _core.no_grad_ctx():
+                out = fn() if fn is not None else None
+            sink = []
+            tpl = _tree_tensors(out, sink)
+            if out_check is not None:
+                out_check(tpl, sink)
+            return tuple(coerce(t)._data for t in sink)
+        finally:
+            _core.set_active_trace(old)
+
+    return run
+
+
 class nn:
     """Static-graph control flow (reference: paddle.static.nn.cond /
     while_loop, the ops paddle.jit dy2static lowers `if`/`while` on tensor
@@ -185,12 +242,15 @@ class nn:
 
     TPU-native lowering:
     - cond: with a concrete predicate (dygraph) only the taken branch runs;
-      under @to_static tracing BOTH branches are traced and the outputs
-      selected elementwise (XLA `select`) — fully differentiable through the
-      tape, so branches must be side-effect-free (the reference imposes the
-      same purity on cond blocks).
-    - while_loop: lax.while_loop over explicit loop_vars.  XLA's
-      while-loop is forward-only; outputs carry stop_gradient=True.
+      under @to_static tracing it lowers to XLA's `conditional` via
+      jax.lax.cond — SINGLE-branch execution at runtime, differentiable,
+      with closure-captured tensors lifted to explicit operands so their
+      gradients flow.  Branches must be side-effect-free (the reference
+      imposes the same purity on cond blocks).
+    - while_loop: lax.while_loop over explicit loop_vars (forward-only,
+      unbounded); pass `max_iters=` to lower to a lax.scan-based bounded
+      loop instead — differentiable through loop_vars AND captures, at the
+      cost of always running max_iters masked iterations.
     """
 
     @staticmethod
@@ -199,7 +259,6 @@ class nn:
 
         from ..framework import core as _core
         from ..ops.dispatch import apply, coerce
-        from ..tensor import Tensor
 
         pred = coerce(pred)
         concrete = not isinstance(pred._data, jax.core.Tracer)
@@ -208,8 +267,15 @@ class nn:
             fn = true_fn if taken else false_fn
             return fn() if fn is not None else None
 
-        t_out = true_fn() if true_fn is not None else None
-        f_out = false_fn() if false_fn is not None else None
+        # discovery: run both branches once at the paddle level (dead code
+        # in the outer program) to learn output structure + captured tensors
+        def _disc():
+            t_out = true_fn() if true_fn is not None else None
+            f_out = false_fn() if false_fn is not None else None
+            return t_out, f_out
+
+        (t_out, f_out), captured = _record_captures(_disc)
+        captured = [t for t in captured if t is not pred]
         t_leaves, f_leaves = [], []
         t_tpl = _tree_tensors(t_out, t_leaves)
         f_tpl = _tree_tensors(f_out, f_leaves)
@@ -218,25 +284,33 @@ class nn:
                 "paddle.static.nn.cond: true_fn and false_fn must return "
                 "the same structure of tensors (got {} vs {})".format(t_tpl, f_tpl)
             )
-        selected = []
         for tt, ft in zip(t_leaves, f_leaves):
-            if tuple(tt.shape) != tuple(ft.shape):
+            if tuple(tt.shape) != tuple(ft.shape) or tt.dtype != ft.dtype:
                 raise ValueError(
                     "paddle.static.nn.cond: branch outputs must have equal "
-                    "shapes, got {} vs {}".format(tt.shape, ft.shape)
+                    "shapes/dtypes, got {}/{} vs {}/{}".format(
+                        tt.shape, tt.dtype, ft.shape, ft.dtype
+                    )
                 )
-            selected.append(
-                apply(
-                    lambda p, a, b: jnp.where(p, a, b),
-                    [pred, tt, ft],
-                    name="cond_select",
-                )
+
+        run_true = _branch_runner(true_fn, captured)
+        run_false = _branch_runner(false_fn, captured)
+
+        def f(p, *cap):
+            return jax.lax.cond(
+                p.reshape(()).astype(bool),
+                lambda c: run_true(c),
+                lambda c: run_false(c),
+                cap,
             )
-        return _tree_restore(t_tpl, selected)
+
+        outs = apply(f, [pred] + captured, multi=True, name="cond")
+        return _tree_restore(t_tpl, list(outs))
 
     @staticmethod
-    def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    def while_loop(cond, body, loop_vars, is_test=False, name=None, max_iters=None):
         from ..framework import core as _core
+        from ..jit import _Trace
         from ..ops.dispatch import apply, coerce
         from ..tensor import Tensor
 
@@ -245,43 +319,105 @@ class nn:
         tpl = _tree_tensors(loop_vars, leaves)
         leaves = [coerce(t) for t in leaves]
 
+        def wrap_vals(vals):
+            ts = []
+            for a in vals:
+                t = Tensor.__new__(Tensor)
+                t._init_from_array(a, stop_gradient=True)
+                ts.append(t)
+            return _tree_restore(tpl, ts)
+
+        def out_arrays(out):
+            sink = []
+            out_tpl = _tree_tensors(list(out), sink)
+            if out_tpl != tpl:
+                raise ValueError(
+                    "paddle.static.nn.while_loop: body must return "
+                    "loop_vars-shaped outputs"
+                )
+            return tuple(coerce(t)._data for t in sink)
+
+        if max_iters is None:
+            # unbounded forward-only loop: XLA while is not differentiable
+            def f(*arrays):
+                def jcond(vals):
+                    with _core.no_grad_ctx():
+                        r = cond(*wrap_vals(list(vals)))
+                    r = coerce(r[0] if isinstance(r, (list, tuple)) else r)
+                    return r._data.reshape(())
+
+                def jbody(vals):
+                    with _core.no_grad_ctx():
+                        out = body(*wrap_vals(list(vals)))
+                    return out_arrays(out)
+
+                return jax.lax.while_loop(jcond, jbody, tuple(arrays))
+
+            outs = apply(
+                f,
+                leaves,
+                name="while_loop",
+                multi=True,
+                outputs_stop_gradient=[True] * len(leaves),
+            )
+            return list(_tree_restore(tpl, list(outs)))
+
+        # bounded differentiable loop (reference: dy2static while supports
+        # grad): lax.scan over max_iters steps with an alive mask — each
+        # step computes body(vals) and keeps the old vals once the loop
+        # condition has gone false.  Gradients flow through loop_vars and
+        # through closure-captured tensors (lifted to operands below).
+        def _disc():
+            out = body(*loop_vars)
+            cond(*loop_vars)
+            return out
+
+        _, captured = _record_captures(_disc)
+        cap_set = {id(t) for t in leaves}
+        captured = [t for t in captured if id(t) not in cap_set]
+        n = len(leaves)
+
         def f(*arrays):
-            def wrap_vals(vals):
-                ts = []
-                for a in vals:
-                    t = Tensor.__new__(Tensor)
-                    t._init_from_array(a, stop_gradient=True)
-                    ts.append(t)
-                return _tree_restore(tpl, ts)
+            vals0, caps = arrays[:n], arrays[n:]
+            subst_base = {(id(t), "data"): a for t, a in zip(captured, caps)}
+
+            def run_paddle(fn_args_fn):
+                tr = _Trace("execute", subst=dict(subst_base))
+                old = _core.set_active_trace(tr)
+                try:
+                    with _core.no_grad_ctx():
+                        return fn_args_fn()
+                finally:
+                    _core.set_active_trace(old)
 
             def jcond(vals):
-                with _core.no_grad_ctx():
-                    r = cond(*wrap_vals(list(vals)))
+                r = run_paddle(lambda: cond(*wrap_vals(list(vals))))
                 r = coerce(r[0] if isinstance(r, (list, tuple)) else r)
-                return r._data.reshape(())
+                return r._data.reshape(()).astype(bool)
 
             def jbody(vals):
-                with _core.no_grad_ctx():
-                    out = body(*wrap_vals(list(vals)))
-                sink = []
-                out_tpl = _tree_tensors(list(out), sink)
-                if out_tpl != tpl:
-                    raise ValueError(
-                        "paddle.static.nn.while_loop: body must return "
-                        "loop_vars-shaped outputs"
-                    )
-                return tuple(t._data for t in sink)
+                return run_paddle(lambda: out_arrays(body(*wrap_vals(list(vals)))))
 
-            return jax.lax.while_loop(jcond, jbody, tuple(arrays))
+            import jax.numpy as _jnp
 
-        outs = apply(
-            f,
-            leaves,
-            name="while_loop",
-            multi=True,
-            outputs_stop_gradient=[True] * len(leaves),
-        )
-        return list(_tree_restore(tpl, list(outs)))
+            def step(carry, _):
+                vals, alive = carry
+                new_vals = jbody(vals)
+                sel = tuple(
+                    _jnp.where(alive, nv, ov) for nv, ov in zip(new_vals, vals)
+                )
+                alive = alive & jcond(sel)
+                return (sel, alive), None
+
+            alive0 = jcond(tuple(vals0))
+            (final, _), _ = jax.lax.scan(
+                step, (tuple(vals0), alive0), None, length=int(max_iters)
+            )
+            return final
+
+        outs = apply(f, leaves + captured, name="while_loop_scan", multi=True)
+        outs = list(outs)[:n]
+        return list(_tree_restore(tpl, outs))
 
     @staticmethod
     def fc(x, size, **kwargs):

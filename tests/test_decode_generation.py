@@ -40,6 +40,7 @@ class TestFlashDecodeKernel:
             (1, 5, 4, 2, 64, 128, 100),    # GQA, chunked decode
             (2, 130, 8, 8, 64, 384, 0),    # prefill-with-cache, odd length
             (1, 3, 2, 2, 128, 256, 252),   # near cache end
+            (1, 64, 8, 2, 64, 128, 30),    # GQA on the Pallas (sq>=64) path
         ],
     )
     def test_parity_dense_and_pallas(self, b, sq, h, hk, d, L, pos):
@@ -202,6 +203,21 @@ class TestBeamSearch:
         model = LlamaForCausalLM(LlamaConfig.tiny())
         with pytest.raises(ValueError, match="temperature"):
             model.generate(ids(1, 4), max_new_tokens=2, decode_strategy="sampling")
+
+    def test_unknown_strategy_raises(self):
+        model = LlamaForCausalLM(LlamaConfig.tiny())
+        with pytest.raises(ValueError, match="decode_strategy"):
+            model.generate(ids(1, 4), max_new_tokens=2, decode_strategy="greedy")
+
+    def test_top_k_larger_than_vocab_is_noop(self):
+        paddle.seed(12)
+        model = LlamaForCausalLM(LlamaConfig.tiny())  # vocab 256
+        x = ids(1, 6, seed=12)
+        out = model.generate(
+            x, max_new_tokens=3, temperature=0.9, top_k=10_000, seed=4
+        )
+        ref = model.generate(x, max_new_tokens=3, temperature=0.9, top_k=0, seed=4)
+        np.testing.assert_array_equal(out.numpy(), ref.numpy())
 
     def test_overlong_prompt_returns_input(self):
         cfg = LlamaConfig.tiny()  # max_position_embeddings=256

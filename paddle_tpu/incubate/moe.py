@@ -35,10 +35,13 @@ from ..tensor import Tensor
 
 def gate_dispatch_tensors(lg, k, capacity):
     """From router logits [T, E] build (dispatch [T, E, C], combine
-    [T, E, C], aux_loss).  Pure jax; shared by the dense path and the
-    per-shard EP path.  Vectorized: lax.top_k picks the k experts at once;
-    the static k-round unroll only sequences capacity priority (round 0
-    tokens claim slots before round 1), matching GShard."""
+    [T, E, C], aux_loss, stats).  Pure jax; shared by the dense path and
+    the per-shard EP path.  Vectorized: lax.top_k picks the k experts at
+    once; the static k-round unroll only sequences capacity priority
+    (round 0 tokens claim slots before round 1), matching GShard.
+
+    stats: (dropped_assignments f32 scalar, expert_used i32 [E]) — the
+    overflow accounting the reference's MoE layer exposes."""
     tokens, e = lg.shape
     probs = jax.nn.softmax(lg.astype(jnp.float32), -1)  # [T, E]
     # aux load-balance loss (GShard eq.): E * sum(me * ce)
@@ -52,6 +55,7 @@ def gate_dispatch_tensors(lg, k, capacity):
     comb = jnp.zeros((tokens, e, capacity), jnp.float32)
     used = jnp.zeros((e,), jnp.int32)
     gates_accum = jnp.zeros((tokens,), jnp.float32)
+    dropped = jnp.zeros((), jnp.float32)
     for r in range(k):
         s = sel[:, r]  # [T, E]
         pos = jnp.cumsum(s, 0) * s - s + used[None, :] * s
@@ -67,8 +71,37 @@ def gate_dispatch_tensors(lg, k, capacity):
         comb = comb + contrib * topv[:, r][:, None, None]
         used = used + (s * fits[:, None].astype(jnp.int32)).sum(0)
         gates_accum = gates_accum + topv[:, r] * fits.astype(jnp.float32)
+        dropped = dropped + (1.0 - fits.astype(jnp.float32)).sum()
     comb = comb / jnp.maximum(gates_accum, 1e-9)[:, None, None]
-    return disp, comb, aux
+    return disp, comb, aux, (dropped, used)
+
+
+def expert_choice_tensors(lg, capacity):
+    """Expert-choice routing (Zhou et al. 2022; the reference exposes it as
+    a gate option): each EXPERT picks its top-`capacity` tokens, so load is
+    balanced by construction (aux loss identically 0) and no token-side
+    overflow exists — tokens chosen by no expert pass through with zero
+    update (residual handles them).  Returns the same (disp, comb, aux,
+    stats) contract as gate_dispatch_tensors."""
+    tokens, e = lg.shape
+    capacity = min(capacity, tokens)  # an expert cannot pick more tokens than exist
+    scores = jax.nn.softmax(lg.astype(jnp.float32), 0)  # over tokens, per expert
+    g, i = lax.top_k(scores.T, capacity)  # [E, C] each: expert -> its tokens
+    sel = jax.nn.one_hot(i, tokens, dtype=jnp.float32)  # [E, C, T]
+    disp = jnp.transpose(sel, (2, 0, 1))  # [T, E, C]
+    comb = disp * g[None]  # g: [E, C] broadcast over tokens
+    covered = jnp.clip(disp.sum((1, 2)), 0.0, 1.0)  # token picked by >=1 expert
+    dropped = (1.0 - covered).sum()
+    used = jnp.full((e,), capacity, jnp.int32)
+    return disp, comb, jnp.zeros((), jnp.float32), (dropped, used)
+
+
+def route_tokens(lg, k, capacity, expert_choice):
+    """Single routing entry shared by the dense gate and the EP shard body
+    (keeps the two paths from diverging)."""
+    if expert_choice:
+        return expert_choice_tensors(lg, capacity)
+    return gate_dispatch_tensors(lg, k, capacity)
 
 
 class TopKGate(nn.Layer):
@@ -76,7 +109,10 @@ class TopKGate(nn.Layer):
 
     def __init__(self, d_model, num_experts, top_k=2, capacity_factor=1.25, gate_type="gshard"):
         super().__init__()
+        if gate_type not in ("gshard", "switch", "expert_choice"):
+            raise ValueError(f"unknown gate_type {gate_type!r}")
         self.num_experts = num_experts
+        self.gate_type = gate_type
         self.top_k = 1 if gate_type == "switch" else top_k
         self.capacity_factor = capacity_factor
         self.wg = nn.Linear(d_model, num_experts, bias_attr=False)
@@ -85,13 +121,16 @@ class TopKGate(nn.Layer):
         return max(int(self.capacity_factor * tokens * self.top_k / self.num_experts), 1)
 
     def forward(self, x):
-        # returns (dispatch [tokens, E, C], combine [tokens, E, C], aux_loss)
+        # returns (dispatch [tokens, E, C], combine [tokens, E, C],
+        # aux_loss, dropped, expert_used)
         logits = self.wg(x)
         cap = self.capacity(int(x.shape[0]))
         k = self.top_k
+        ec = self.gate_type == "expert_choice"
 
         def f(lg):
-            return gate_dispatch_tensors(lg, k, cap)
+            disp, comb, aux, (dropped, used) = route_tokens(lg, k, cap, ec)
+            return disp, comb, aux, dropped, used
 
         return apply(f, [coerce(logits)], multi=True, name="moe_gate")
 
@@ -147,6 +186,18 @@ class MoELayer(nn.Layer):
         self.gate = TopKGate(d_model, num_experts, top_k, capacity_factor, gate)
         self.experts = ExpertFFN(num_experts, d_model, d_hidden, activation)
         self.aux_loss = None
+        # routing telemetry, refreshed every forward (reference: the MoE
+        # layer's overflow counters): dropped assignment count, fraction of
+        # the T*k routing slots dropped, per-expert slot usage [E]
+        self.drop_stats = None
+
+    def _set_stats(self, dropped, used, tokens):
+        k = self.gate.top_k if self.gate.gate_type != "expert_choice" else 1
+        self.drop_stats = {
+            "dropped_tokens": dropped,
+            "dropped_fraction": dropped / float(max(tokens * k, 1)),
+            "expert_used": used,
+        }
 
     def forward(self, x):
         b, s, d = x.shape[0], x.shape[1], x.shape[2]
@@ -155,8 +206,9 @@ class MoELayer(nn.Layer):
             out, aux = self._ep_forward(flat)
             self.aux_loss = aux
             return out.reshape([b, s, d])
-        disp, comb, aux = self.gate(flat)
+        disp, comb, aux, dropped, used = self.gate(flat)
         self.aux_loss = aux
+        self._set_stats(dropped, used, int(flat.shape[0]))
         ins = [coerce(flat), coerce(disp)]
 
         def dispatch(a, dsp):
@@ -178,7 +230,10 @@ class MoELayer(nn.Layer):
     def _ep_forward(self, flat):
         """shard_map over 'ep': local gating → all_to_all dispatch → local
         experts → all_to_all combine.  Tokens are ep-sharded on entry; the
-        expert count must divide by ep."""
+        expert count must divide by ep.  A token count that does NOT divide
+        by ep (the varlen tail-batch case) is zero-padded up and the pad
+        rows sliced off after the exchange — they occupy gate slots on the
+        last shard only, the same skew the reference's padded dispatch has."""
         from jax.experimental.shard_map import shard_map
 
         mesh = _mesh.get_mesh()
@@ -187,10 +242,19 @@ class MoELayer(nn.Layer):
         if e % ep != 0:
             raise ValueError(f"num_experts {e} must divide by ep degree {ep}")
         tokens = int(flat.shape[0])
-        if tokens % ep != 0:
-            raise ValueError(f"token count {tokens} must divide by ep degree {ep}")
-        cap_local = self.gate.capacity(tokens // ep)
+        pad = (-tokens) % ep
+        if pad:
+            from .. import ops as _ops
+
+            zeros = apply(
+                lambda a: jnp.zeros((pad, a.shape[1]), a.dtype), [coerce(flat)],
+                name="moe_pad",
+            )
+            flat = _ops.concat([flat, zeros], axis=0)
+        tokens_p = tokens + pad
+        cap_local = self.gate.capacity(tokens_p // ep)
         k = self.gate.top_k
+        ec = self.gate.gate_type == "expert_choice"
         act = jax.nn.gelu if self.experts.activation == "gelu" else jax.nn.relu
 
         @functools.partial(
@@ -204,12 +268,12 @@ class MoELayer(nn.Layer):
                 P("ep", None, None),
                 P("ep", None, None),
             ),
-            out_specs=(P("ep", None), P()),
+            out_specs=(P("ep", None), P(), P(), P(None)),
             check_rep=False,
         )
         def local(fl, wg, w1, b1, w2, b2):
             lg = fl.astype(jnp.float32) @ wg.astype(jnp.float32)  # [T_l, E]
-            disp, comb, aux = gate_dispatch_tensors(lg, k, cap_local)
+            disp, comb, aux, (dropped, used) = route_tokens(lg, k, cap_local, ec)
             ein = jnp.einsum("td,tec->ecd", fl, disp.astype(fl.dtype))  # [E, C_l, D]
             # exchange: split experts across peers, gather their token slots
             ein = lax.all_to_all(ein, "ep", split_axis=0, concat_axis=1, tiled=True)
@@ -218,18 +282,24 @@ class MoELayer(nn.Layer):
             h = lax.all_to_all(h, "ep", split_axis=1, concat_axis=0, tiled=True)
             out = jnp.einsum("ecd,tec->td", h, comb.astype(h.dtype))  # [T_l, D]
             aux = lax.pmean(aux, "ep")
-            return out, aux
+            dropped = lax.psum(dropped, "ep")
+            used = lax.psum(used, "ep")
+            return out, aux, dropped, used
 
         xp = self.experts
 
         def f(fl, wg, w1, b1, w2, b2):
             fl = _mesh.constraint(fl, P("ep", None))
-            return local(fl, wg, w1, b1, w2, b2)
+            out, aux, dropped, used = local(fl, wg, w1, b1, w2, b2)
+            if pad:
+                out = out[:tokens]
+            return out, aux, dropped, used
 
-        out, aux = apply(
+        out, aux, dropped, used = apply(
             f,
             [coerce(flat), self.gate.wg.weight, xp.w1, xp.b1, xp.w2, xp.b2],
             multi=True,
             name="moe_ep_a2a",
         )
+        self._set_stats(dropped, used, tokens_p)
         return out, aux

@@ -190,6 +190,125 @@ class BatchSampler(Sampler):
         return (n + self.batch_size - 1) // self.batch_size
 
 
+class BucketSampler(Sampler):
+    """Length-bucketed batch sampler for ragged datasets (SURVEY §7 "hard
+    parts: dynamic shapes").  Samples are grouped by the smallest
+    `bucket_boundaries` entry >= their length and batched within a bucket;
+    with `padded_collate` below every emitted batch has one of
+    len(bucket_boundaries) static shapes, so a @to_static train step
+    compiles AT MOST once per bucket — the retrace contract — instead of
+    once per distinct tail length.
+
+    lengths: per-sample sequence lengths (list/array), or None to derive
+    as len(dataset[i][0]) (first field of each sample).
+    """
+
+    def __init__(self, dataset=None, lengths=None, bucket_boundaries=(64, 128, 256, 512),
+                 batch_size=1, shuffle=False, drop_last=False, seed=0,
+                 pad_last_batch=True):
+        # pad_last_batch: wrap a bucket's tail batch with indices from the
+        # same bucket (the DistributedBatchSampler precedent) so EVERY batch
+        # is [batch_size, boundary]-shaped and the <= len(boundaries)
+        # compiles contract holds; set False (or drop_last=True) to opt out.
+        self.pad_last_batch = pad_last_batch
+        if lengths is None:
+            if dataset is None:
+                raise ValueError("BucketSampler needs `dataset` or `lengths`")
+            lengths = [len(dataset[i][0]) for i in range(len(dataset))]
+        self.lengths = [int(x) for x in lengths]
+        self.boundaries = sorted(int(b) for b in bucket_boundaries)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.epoch = 0
+        too_long = [i for i, n in enumerate(self.lengths) if n > self.boundaries[-1]]
+        if too_long:
+            raise ValueError(
+                f"BucketSampler: {len(too_long)} samples exceed the largest "
+                f"bucket boundary {self.boundaries[-1]} (first: index "
+                f"{too_long[0]}, length {self.lengths[too_long[0]]})"
+            )
+        self._buckets = {}
+        for i, n in enumerate(self.lengths):
+            b = next(bd for bd in self.boundaries if n <= bd)
+            self._buckets.setdefault(b, []).append(i)
+
+    def bucket_of(self, idx):
+        n = self.lengths[idx]
+        return next(bd for bd in self.boundaries if n <= bd)
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __iter__(self):
+        rng = np.random.RandomState(self.seed + self.epoch) if self.shuffle else None
+        batches = []
+        for bd in self.boundaries:
+            idxs = list(self._buckets.get(bd, []))
+            if not idxs:
+                continue
+            if rng is not None:
+                rng.shuffle(idxs)
+            for i in range(0, len(idxs), self.batch_size):
+                chunk = idxs[i : i + self.batch_size]
+                if len(chunk) < self.batch_size:
+                    if self.drop_last:
+                        continue
+                    if self.pad_last_batch:
+                        wrap = idxs
+                        while len(chunk) < self.batch_size:
+                            chunk = chunk + wrap[: self.batch_size - len(chunk)]
+                batches.append(chunk)
+        if rng is not None:
+            rng.shuffle(batches)
+        return iter(batches)
+
+    def __len__(self):
+        n = 0
+        for idxs in self._buckets.values():
+            if self.drop_last:
+                n += len(idxs) // self.batch_size
+            else:
+                n += (len(idxs) + self.batch_size - 1) // self.batch_size
+        return n
+
+
+def padded_collate(bucket_boundaries, ragged_fields=(0,), pad_value=0):
+    """Collate-fn factory for BucketSampler batches: ragged fields are
+    padded (axis 0) to the smallest bucket boundary >= the batch max
+    length, and a `lengths` int32 vector is APPENDED to each sample tuple
+    so models can build padding masks / flash-attention segment ids
+    (models/bert.py turns exactly such masks into Pallas segment ids)."""
+    boundaries = sorted(int(b) for b in bucket_boundaries)
+
+    def collate(batch):
+        lengths = np.asarray(
+            [len(np.asarray(sample[ragged_fields[0]])) for sample in batch], np.int32
+        )
+        if int(lengths.max()) > boundaries[-1]:
+            # an explicit error — a bare StopIteration from next() would
+            # surface as an opaque "generator raised StopIteration" (PEP 479)
+            raise ValueError(
+                f"padded_collate: sample length {int(lengths.max())} exceeds "
+                f"the largest bucket boundary {boundaries[-1]}"
+            )
+        target = next(bd for bd in boundaries if bd >= int(lengths.max()))
+        padded = []
+        for sample in batch:
+            fields = list(sample) if isinstance(sample, (list, tuple)) else [sample]
+            for fi in ragged_fields:
+                a = np.asarray(fields[fi])
+                if a.shape[0] < target:
+                    pad = [(0, target - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+                    a = np.pad(a, pad, constant_values=pad_value)
+                fields[fi] = a
+            padded.append(tuple(fields) + (np.int32(len(np.asarray(sample[ragged_fields[0]]))),))
+        return default_collate_fn(padded)
+
+    return collate
+
+
 class DistributedBatchSampler(BatchSampler):
     """Per-rank sharded sampler (reference:
     python/paddle/io/dataloader/batch_sampler.py DistributedBatchSampler)."""

@@ -201,10 +201,20 @@ def _record_captures(run):
         box["out"] = out
         sink = []
         _tree_tensors(out, sink)
+        box["sink"] = sink
         return tuple(coerce(t)._data for t in sink)
 
     jax.eval_shape(wrapped)
-    return box["out"], rec.captured()
+    captured = rec.captured()
+    # a block may return a pre-existing tensor DIRECTLY (no op touches it,
+    # so apply() never records it) — it still needs to be an operand or its
+    # gradient is silently lost
+    seen = {id(t) for t in captured}
+    for t in box["sink"]:
+        if id(t) not in rec.created and id(t) not in seen:
+            seen.add(id(t))
+            captured.append(t)
+    return box["out"], captured
 
 
 def _branch_runner(fn, captured, out_check=None):

@@ -1,0 +1,151 @@
+"""Varlen/tail batching policy (round-4 verdict missing #6, SURVEY §7
+"dynamic shapes"): BucketSampler + padded_collate bound the number of
+compiled-step retraces to the number of shape buckets, and padding masks
+ride the flash kernel as segment ids (models/bert.py)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.io import BucketSampler, DataLoader, Dataset, padded_collate
+
+
+class RaggedDS(Dataset):
+    """Token sequences with ragged lengths including awkward tails."""
+
+    def __init__(self, lengths, vocab=50, seed=0):
+        rng = np.random.RandomState(seed)
+        self.rows = [
+            (rng.randint(0, vocab, (n,)).astype(np.int32), np.int64(i % 3))
+            for i, n in enumerate(lengths)
+        ]
+
+    def __len__(self):
+        return len(self.rows)
+
+    def __getitem__(self, i):
+        return self.rows[i]
+
+
+LENGTHS = [5, 9, 13, 17, 21, 25, 29, 31, 8, 16, 24, 32, 7, 15, 23, 31, 3, 11]
+BOUNDS = (8, 16, 32)
+
+
+class TestBucketSampler:
+    def test_batches_stay_within_buckets(self):
+        ds = RaggedDS(LENGTHS)
+        bs = BucketSampler(ds, bucket_boundaries=BOUNDS, batch_size=4)
+        seen = set()
+        for batch in bs:
+            bd = {bs.bucket_of(i) for i in batch}
+            assert len(bd) == 1  # never mixes buckets
+            assert len(batch) == 4  # tails wrap within the bucket
+            seen.update(batch)
+        assert seen == set(range(len(LENGTHS)))  # every sample appears
+
+    def test_too_long_sample_raises(self):
+        ds = RaggedDS([4, 100])
+        try:
+            BucketSampler(ds, bucket_boundaries=(8, 16), batch_size=2)
+        except ValueError as e:
+            assert "exceed" in str(e)
+        else:
+            raise AssertionError("expected ValueError")
+
+    def test_shuffle_is_epoch_deterministic(self):
+        ds = RaggedDS(LENGTHS)
+        bs = BucketSampler(ds, bucket_boundaries=BOUNDS, batch_size=4, shuffle=True)
+        a = list(bs)
+        b = list(bs)
+        assert a == b
+        bs.set_epoch(1)
+        assert list(bs) != a  # new epoch, new order
+
+    def test_padded_collate_shapes(self):
+        ds = RaggedDS(LENGTHS)
+        bs = BucketSampler(ds, bucket_boundaries=BOUNDS, batch_size=4)
+        dl = DataLoader(ds, batch_sampler=bs, collate_fn=padded_collate(BOUNDS))
+        shapes = set()
+        for toks, label, lens in dl:
+            assert toks.shape[1] in BOUNDS
+            shapes.add(toks.shape[1])
+            lens_np = lens.numpy()
+            toks_np = toks.numpy()
+            for r in range(toks_np.shape[0]):
+                assert (toks_np[r, lens_np[r]:] == 0).all()  # padded tail
+        assert shapes == set(BOUNDS)
+
+    def test_padded_collate_overlong_sample_raises_clearly(self):
+        from paddle_tpu.io import padded_collate
+
+        fn = padded_collate((8, 16))
+        try:
+            fn([(np.zeros(20, np.int32), np.int64(0))])
+        except ValueError as e:
+            assert "exceeds" in str(e)
+        else:
+            raise AssertionError("expected ValueError")
+
+    def test_ragged_training_compiles_at_most_once_per_bucket(self):
+        # the retrace contract: a @to_static step over the bucketed loader
+        # compiles <= len(BOUNDS) times, padding masks ride as segment ids
+        from paddle_tpu import nn
+
+        ds = RaggedDS(LENGTHS)
+        bs = BucketSampler(ds, bucket_boundaries=BOUNDS, batch_size=4)
+        dl = DataLoader(ds, batch_sampler=bs, collate_fn=padded_collate(BOUNDS))
+
+        emb = nn.Embedding(50, 16)
+        head = nn.Linear(16, 3)
+        ce = nn.CrossEntropyLoss()
+        opt = paddle.optimizer.SGD(
+            learning_rate=0.01, parameters=list(emb.parameters()) + list(head.parameters())
+        )
+
+        @paddle.jit.to_static
+        def step(toks, label, lens):
+            x = emb(toks)  # [b, s, 16]
+            mask = (
+                paddle.arange(0, toks.shape[1]).unsqueeze(0) < lens.unsqueeze(1)
+            ).astype("float32")
+            pooled = (x * mask.unsqueeze(-1)).sum(axis=1) / mask.sum(
+                axis=1, keepdim=True
+            )
+            loss = ce(head(pooled), label)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        losses = []
+        for epoch in range(2):
+            bs.set_epoch(epoch)
+            for toks, label, lens in dl:
+                losses.append(float(step(toks, label, lens).numpy()))
+        assert step.trace_count <= len(BOUNDS)
+        assert np.isfinite(losses).all()
+
+    def test_bert_padded_bucket_stays_on_fast_path(self):
+        # the padded batch's mask becomes flash segment ids — assert the
+        # Pallas kernel (interpret mode) runs without the mask fallback
+        from paddle_tpu.models.bert import BertConfig, BertModel
+        from paddle_tpu.ops import flash_attention as fa
+
+        cfg = BertConfig.tiny(max_position_embeddings=128)
+        model = BertModel(cfg)
+        toks = np.zeros((2, 128), np.int32)
+        lens = np.array([100, 128], np.int32)
+        toks[0, :100] = 1
+        toks[1] = 2
+        mask = (np.arange(128)[None, :] < lens[:, None]).astype(np.int64)
+        saved, saved_log = fa._FORCE_INTERPRET, fa._fallback_logged
+        fa._FORCE_INTERPRET = True
+        fa._fallback_logged = False
+        try:
+            model(
+                paddle.to_tensor(toks),
+                attention_mask=paddle.to_tensor(mask),
+            )
+            assert not fa._fallback_logged  # segment ids, not an additive mask
+        finally:
+            fa._FORCE_INTERPRET = saved
+            fa._fallback_logged = saved_log

@@ -325,6 +325,28 @@ class TestControlFlow:
         assert float(out.numpy()) == 8.0  # w^3
         np.testing.assert_allclose(w.grad.numpy(), 12.0)  # 3 w^2
 
+    def test_cond_passthrough_branch_keeps_grad(self):
+        # a branch returning a captured tensor DIRECTLY (no op) must still
+        # surface its gradient (review finding: apply() never sees it, so
+        # discovery must lift returned pre-existing tensors to operands)
+        import paddle_tpu.static as static
+
+        x = t(np.array([3.0], np.float32))
+        x.stop_gradient = False
+        y = t(np.array([5.0], np.float32))
+        y.stop_gradient = False
+
+        @paddle.jit.to_static
+        def model():
+            s = x.sum()
+            out = static.nn.cond(s > 0, lambda: x, lambda: y)
+            (out * 2.0).sum().backward()
+            return out
+
+        out = model()
+        np.testing.assert_allclose(out.numpy(), [3.0])
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
     def test_while_loop_max_iters_eager(self):
         import paddle_tpu.static as static
 

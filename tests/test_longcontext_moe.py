@@ -111,6 +111,65 @@ class TestMoE:
             losses.append(float(loss.numpy()))
         assert losses[-1] < losses[0]
 
+    def test_drop_stats_surface(self):
+        # tiny capacity forces overflow; the layer must report it
+        paddle.seed(1)
+        moe = MoELayer(d_model=8, d_hidden=16, num_experts=2, top_k=2,
+                       capacity_factor=0.25)
+        x = t(np.random.randn(2, 16, 8))
+        moe(x)
+        st = moe.drop_stats
+        assert st is not None
+        assert float(st["dropped_tokens"].numpy()) > 0
+        assert 0 < float(st["dropped_fraction"].numpy()) <= 1
+        assert st["expert_used"].shape == [2]
+        # ample capacity: nothing dropped
+        moe2 = MoELayer(d_model=8, d_hidden=16, num_experts=2, top_k=2,
+                        capacity_factor=8.0)
+        moe2(x)
+        assert float(moe2.drop_stats["dropped_tokens"].numpy()) == 0
+
+    def test_expert_choice_capacity_clamps_to_tokens(self):
+        # capacity_factor * tokens * k / E can exceed the token count;
+        # EC must clamp, not crash in lax.top_k (review finding)
+        paddle.seed(5)
+        moe = MoELayer(d_model=8, d_hidden=16, num_experts=2, top_k=2,
+                       gate="expert_choice", capacity_factor=2.0)
+        out = moe(t(np.random.randn(2, 16, 8)))
+        assert out.shape == [2, 16, 8]
+
+    def test_expert_choice_gate(self):
+        # EC routing: balanced by construction, aux == 0, trains
+        paddle.seed(2)
+        moe = MoELayer(d_model=16, d_hidden=32, num_experts=4,
+                       gate="expert_choice", capacity_factor=2.0)
+        x = t(np.random.randn(2, 16, 16))
+        out = moe(x)
+        assert out.shape == [2, 16, 16]
+        assert float(moe.aux_loss.numpy()) == 0.0
+        used = moe.drop_stats["expert_used"].numpy()
+        assert (used == used[0]).all()  # every expert exactly at capacity
+        opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=moe.parameters())
+        y = t(np.random.randn(2, 16, 16))
+        losses = []
+        for _ in range(10):
+            loss = ((moe(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_ep_ragged_tokens_padded(self):
+        # tokens % ep != 0 must pad, not raise (varlen tail batch)
+        pmesh.build_mesh(ep=4)
+        paddle.seed(4)
+        moe = MoELayer(16, 32, num_experts=8, top_k=2, capacity_factor=8.0)
+        x = t(np.random.randn(3, 7, 16).astype(np.float32))  # 21 tokens, ep=4
+        out = moe(x)
+        assert out.shape == [3, 7, 16]
+        assert moe.drop_stats is not None
+
     def test_ep_sharded_experts(self):
         pmesh.build_mesh(mp=4)
         paddle.seed(0)

@@ -330,15 +330,17 @@ def bench_moe():
     x = paddle.to_tensor(rng.randn(batch, seq, d_model).astype(np.float32))
     out = step(x)
     out[0].numpy()
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = step(x)
-    aux = float(out[1].numpy())
-    dropf = float(out[2].numpy())
-    dt = time.perf_counter() - t0
+    rates = []
+    for _ in range(3 if on_tpu else 1):  # median-of-3, same as the other legs
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = step(x)
+        aux = float(out[1].numpy())  # syncs the window
+        dropf = float(out[2].numpy())
+        rates.append(batch * seq * steps / (time.perf_counter() - t0))
     return {
         "metric": "moe_gshard_tokens_per_sec",
-        "value": round(batch * seq * steps / dt, 1),
+        "value": round(sorted(rates)[len(rates) // 2], 1),
         "unit": "tokens/s",
         "aux_loss": round(aux, 4),
         "dropped_fraction": round(dropf, 4),
@@ -437,13 +439,18 @@ def bench_longcontext_32k():
 
     def time_it(fn, *args, iters=3):
         # a real host transfer is the only reliable sync point through the
-        # axon tunnel (block_until_ready returns before execution retires)
+        # axon tunnel (block_until_ready returns before execution retires).
+        # Median of 3 windows: shared-chip stalls swing single windows by
+        # +/-30%, and the ratio metric divides two of these.
         np.asarray(fn(*args)[0][0, 0, 0])
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            r = fn(*args)
-        np.asarray(r[0][0, 0, 0])
-        return (time.perf_counter() - t0) / iters
+        rates = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                r = fn(*args)
+            np.asarray(r[0][0, 0, 0])
+            rates.append((time.perf_counter() - t0) / iters)
+        return sorted(rates)[1]
 
     t_flash = time_it(flash_step, q, k, v)
 
@@ -459,21 +466,18 @@ def bench_longcontext_32k():
     vf = v.transpose(0, 2, 1, 3).reshape(H, S, D)
 
     def _fwd(qf, kf, vf):
-        acc_out = acc_lse = None
+        # hops merge IN-KERNEL via the (out, lse) continuation carry —
+        # the per-hop logaddexp/reweigh chain was the round-4 gap's bulk
+        out = lse3 = None
         for hop in range(R):
             ks = kf[:, hop * sq : (hop + 1) * sq]
             vs = vf[:, hop * sq : (hop + 1) * sq]
-            o_h, l_h = fa._pallas_flash_forward(qf, ks, vs, False, scale)
-            l_h = l_h[..., 0]
-            if acc_out is None:
-                acc_out, acc_lse = o_h.astype(jnp.float32), l_h
-            else:
-                new_lse = jnp.logaddexp(acc_lse, l_h)
-                acc_out = acc_out * jnp.exp(acc_lse - new_lse)[..., None] + o_h.astype(
-                    jnp.float32
-                ) * jnp.exp(l_h - new_lse)[..., None]
-                acc_lse = new_lse
-        return acc_out.astype(qf.dtype), acc_lse
+            out, lse3 = fa._pallas_flash_forward(
+                qf, ks, vs, False, scale,
+                carry=None if out is None else (out, lse3),
+                out_dtype=jnp.float32,
+            )
+        return out.astype(qf.dtype), lse3[..., 0]
 
     @jax.custom_vjp
     def ring_core(qf, kf, vf):
@@ -486,13 +490,16 @@ def bench_longcontext_32k():
     def bwd_rule(res, g):
         qf, kf, vf, out, lse = res
         lse3 = lse[..., None]
+        delta = jnp.sum(
+            g.astype(jnp.float32) * out.astype(jnp.float32), -1, keepdims=True
+        )  # hop-invariant: once for all hops
         dq = jnp.zeros(qf.shape, jnp.float32)
         dks, dvs = [], []
         for hop in range(R):
             ks = kf[:, hop * sq : (hop + 1) * sq]
             vs = vf[:, hop * sq : (hop + 1) * sq]
             dq_h, dk_h, dv_h = fa._pallas_flash_backward(
-                qf, ks, vs, g, out, lse3, False, scale
+                qf, ks, vs, g, out, lse3, False, scale, delta=delta
             )
             dq = dq + dq_h.astype(jnp.float32)
             dks.append(dk_h)
@@ -520,8 +527,11 @@ def bench_longcontext_32k():
         "flash_ms": round(t_flash * 1000, 1),
         "ring_per_device_ms": round(t_ring * 1000, 1),
         "ring_vs_split_flash": round(ratio, 2),
-        "note": "flash == Ulysses per-chip cost; ring gap is per-hop kernel "
-        "launch overhead (8 hops x 3 launches vs one fused kernel)",
+        "note": "flash == Ulysses per-chip cost; hops merge in-kernel via the "
+        "(out,lse) carry and delta is hop-invariant (round-5); the residual "
+        "gap is causal work imbalance — the last ring device does ~2x the "
+        "average (zig-zag chunk layout is the known fix), plus causal flash "
+        "pays full DMA for half the compute, inflating the denominator",
     }
 
 

@@ -45,6 +45,7 @@ from . import profiler
 from . import incubate
 from . import sparse
 from . import fft
+from . import distribution
 from . import static
 from . import inference
 from .framework.io import save, load  # noqa: F401

@@ -209,6 +209,22 @@ def _topo_order(roots):
 # during backward automatically)
 _post_backward_hooks = weakref.WeakKeyDictionary()
 
+# callables invoked BEFORE the backward traversal with the set of reachable
+# leaf-tensor ids — the Reducer uses this to pre-mark params unreachable
+# from the loss so its in-order bucket flush keeps overlapping under
+# find_unused_parameters (reference: reducer.cc prepare_for_backward's
+# graph walk)
+_pre_backward_hooks = weakref.WeakKeyDictionary()
+
+
+def register_pre_backward_hook(owner, fn):
+    import inspect
+
+    if inspect.ismethod(fn):
+        _pre_backward_hooks[owner] = weakref.WeakMethod(fn)
+    else:
+        _pre_backward_hooks[owner] = fn
+
 
 def register_post_backward_hook(owner, fn):
     # a bound method of `owner` stored as the VALUE would strongly reference
@@ -265,6 +281,19 @@ def run_backward(
         roots.append(node)
 
     order = _topo_order(roots)
+
+    if _pre_backward_hooks:
+        reachable = {id(t) for t, _ in leaf_results}
+        for node in order:
+            for e in node.input_edges:
+                if e.node is None and e.tensor is not None:
+                    reachable.add(id(e.tensor))
+        for cb in list(_pre_backward_hooks.values()):
+            if isinstance(cb, weakref.WeakMethod):
+                cb = cb()
+                if cb is None:
+                    continue
+            cb(reachable)
 
     def _apply_hooks(t, g):
         if t is not None and t._hooks:

@@ -31,6 +31,52 @@ from ....tensor import Tensor
 from ... import collective as _collective
 
 
+# (shape, dtype) -> (mesh, jitted mean, sharding) — one compiled executable
+# per bucket geometry, reused every step
+_XPROC_CACHE = {}
+
+
+def _cross_process_mean(arr):
+    """Average a process-local flat bucket across all processes.
+
+    The local bucket is placed on each local device as one [1, n] shard of
+    a global [n_devices, n] array over a 1-axis mesh; a cached compiled
+    `mean(axis=0)` (replicated output) runs as one SPMD program — XLA
+    lowers it to an all-reduce, and no host ever holds a stacked
+    [world, n] array.  Every process must flush buckets in the same order
+    (they do: bucket assignment is deterministic), the usual collective
+    contract."""
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    key = (tuple(arr.shape), str(arr.dtype))
+    ent = _XPROC_CACHE.get(key)
+    if ent is None:
+        devs = np.asarray(jax.devices())  # all devices, every process
+        mesh = Mesh(devs, ("d",))
+        in_s = NamedSharding(mesh, P("d"))
+        out_s = NamedSharding(mesh, P())
+
+        import jax.numpy as jnp
+
+        fn = jax.jit(
+            lambda a: a.astype(jnp.float32).mean(0).astype(a.dtype),
+            in_shardings=in_s,
+            out_shardings=out_s,
+        )
+        ent = (mesh, in_s, fn)
+        _XPROC_CACHE[key] = ent
+    mesh, in_s, fn = ent
+    shards = [jax.device_put(arr[None], d) for d in jax.local_devices()]
+    garr = jax.make_array_from_single_device_arrays(
+        (len(mesh.devices.ravel()),) + tuple(arr.shape), in_s, shards
+    )
+    out = fn(garr)
+    # replicated result: hand back this process's addressable copy
+    return out.addressable_data(0)
+
+
 class Reducer:
     def __init__(self, parameters, group=None, bucket_cap_mb=25, find_unused_parameters=False):
         self._params = [p for p in parameters if not p.stop_gradient]
@@ -76,9 +122,18 @@ class Reducer:
             p.register_hook(self._weak_hook(wr, id(p)))
         # finalize automatically at the end of every backward pass (the
         # reference Reducer syncs during backward with no explicit call)
-        from ....autograd.engine import register_post_backward_hook
+        from ....autograd.engine import (
+            register_post_backward_hook,
+            register_pre_backward_hook,
+        )
 
         register_post_backward_hook(self, self._on_backward_done)
+        if self._find_unused:
+            # reference reducer.cc prepare_for_backward: walk the graph up
+            # front to mark params unreachable from the loss, so the
+            # in-order flush below never stalls waiting for them — overlap
+            # stays on under find_unused_parameters
+            register_pre_backward_hook(self, self._on_backward_start)
 
     @staticmethod
     def _weak_hook(wr, pid):
@@ -99,7 +154,28 @@ class Reducer:
 
         return self._force_sync or jax.process_count() > 1
 
+    def _on_backward_start(self, reachable_ids):
+        """Pre-mark params the loss cannot reach as ready (no grad will
+        arrive for them this backward)."""
+        if not (self._enabled and self._sync_needed()):
+            return
+        if _core.active_trace() is not None:
+            return
+        for p in self._params:
+            pid = id(p)
+            if pid not in reachable_ids and pid not in self._ready:
+                bi = self._bucket_of.get(pid)
+                if bi is not None:
+                    self._ready.add(pid)
+                    self._remaining[bi] -= 1
+
     def _on_backward_done(self):
+        if _core.active_trace() is not None:
+            # a compiled step's backward fired the hook: GSPMD reduces
+            # gradients inside the program — eager flushing here would
+            # record stray ops (and write tracers into grads) of whatever
+            # params this Reducer still tracks
+            return
         if self._enabled and self._sync_needed():
             self.finalize()
         else:
@@ -130,14 +206,10 @@ class Reducer:
             # extra contribution after the bucket already flushed
             # (multiply-used parameter): needs a re-reduce at finalize
             self._synced[bi] = False
-        if self._find_unused:
-            # a never-used param would stall the in-order flush below at its
-            # bucket forever; with the flag set, defer everything to the
-            # post-backward finalize (correct, no overlap) — the reference
-            # instead walks the autograd graph up front to mark unused
-            return grad
         # in-order overlap flush: buckets strictly BEFORE this one have
         # fully-accumulated grads once a later bucket starts arriving
+        # (under find_unused_parameters the pre-backward graph walk already
+        # marked unreachable params ready, so the order never stalls)
         while (
             self._next_unflushed < bi
             and self._remaining[self._next_unflushed] == 0
@@ -150,7 +222,20 @@ class Reducer:
         return grad
 
     def _flush(self, bucket):
-        pairs = [(p, p.grad) for p in bucket if p._grad_raw is not None]
+        if jax.process_count() > 1:
+            # rank-invariant geometry: with find_unused_parameters and
+            # data-dependent branches, ranks may disagree on WHICH params
+            # have grads — the fused collective must still line up, so
+            # absent grads ride as zeros and every bucket member gets the
+            # cross-rank average written back (torch DDP semantics)
+            from ....ops.creation import zeros_like as _zeros_like
+
+            pairs = [
+                (p, p.grad if p._grad_raw is not None else _zeros_like(p))
+                for p in bucket
+            ]
+        else:
+            pairs = [(p, p.grad) for p in bucket if p._grad_raw is not None]
         if not pairs:
             return
         if not self._force_sync:
@@ -170,13 +255,13 @@ class Reducer:
         flat = concat([reshape(g, [-1]) for g in grads], axis=0)
         if jax.process_count() > 1:
             # process-local grads on a multi-process job: the fused bucket
-            # crosses hosts via the coordination-backed allgather (one
-            # global computation over all processes), then averages —
-            # the eager axis-less collective cannot span processes
-            from jax.experimental import multihost_utils
-
-            stacked = multihost_utils.process_allgather(flat._raw)
-            flat._data = jnp.mean(stacked, axis=0)
+            # becomes ONE shard of a global array and a cached compiled
+            # mean-reduce runs SPMD over all processes — O(bucket) memory
+            # per host, a real allreduce on the wire (reference reducer.cc
+            # fused allreduce; SURVEY §5.8 eager-collectives design).  The
+            # old process_allgather+mean materialized [world, bucket] on
+            # every host.
+            flat._data = _cross_process_mean(flat._raw)
         else:
             _collective.all_reduce(flat, op=_collective.ReduceOp.AVG, group=self._group)
         sizes = [int(np.prod(g.shape or [1])) for g in grads]

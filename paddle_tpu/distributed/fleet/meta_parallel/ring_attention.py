@@ -117,30 +117,28 @@ def _ring_attention_pallas_local(q, k, v, axis_name, causal, scale):
     def _fwd(qf, kf, vf):
         my = jax.lax.axis_index(axis_name)
         kcur, vcur = kf, vf
-        acc_out = None
-        acc_lse = None
+        out = None
+        lse3 = None  # [bh, sq, 1] — the kernels' native lse layout
         for hop in range(R):
+            # hops > 0 merge IN-KERNEL via the (out, lse) continuation carry
+            # — the per-hop logaddexp/reweigh elementwise chain was ~1/3 of
+            # the round-4 ring gap
             o_h, l_h = fa._pallas_flash_forward(
-                qf, kcur, vcur, causal and hop == 0, scale, interpret=interp
+                qf, kcur, vcur, causal and hop == 0, scale, interpret=interp,
+                carry=None if out is None else (out, lse3),
+                out_dtype=jnp.float32,  # fp32 partials between hops
             )
-            l_h = l_h[..., 0]
             if hop_gate(hop):
+                # device-level causal gate: a hop whose kv block is in this
+                # device's future contributes nothing — keep the carry
                 ok = ((my - hop) % R) < my  # kv block strictly in the past
-                l_h = jnp.where(ok, l_h, -jnp.inf)
-                o_h = jnp.where(ok, o_h, 0)
-            if acc_out is None:
-                acc_out = o_h.astype(jnp.float32)
-                acc_lse = l_h
-            else:
-                new_lse = jnp.logaddexp(acc_lse, l_h)
-                w1 = jnp.exp(acc_lse - new_lse)[..., None]
-                w2 = jnp.exp(l_h - new_lse)[..., None]
-                acc_out = acc_out * w1 + o_h.astype(jnp.float32) * w2
-                acc_lse = new_lse
+                o_h = jnp.where(ok, o_h, out)
+                l_h = jnp.where(ok, l_h, lse3)
+            out, lse3 = o_h, l_h
             if hop < R - 1:
                 kcur = jax.lax.ppermute(kcur, axis_name, perm)
                 vcur = jax.lax.ppermute(vcur, axis_name, perm)
-        return acc_out.astype(qf.dtype), acc_lse
+        return out, lse3[..., 0]
 
     @jax.custom_vjp
     def core(qf, kf, vf):
@@ -154,6 +152,11 @@ def _ring_attention_pallas_local(q, k, v, axis_name, causal, scale):
         qf, kf, vf, out, lse = res
         my = jax.lax.axis_index(axis_name)
         lse3 = lse[..., None]
+        # delta = rowsum(g * out) is hop-invariant: compute ONCE for all R
+        # hops (it was recomputed inside every per-hop backward call)
+        delta = jnp.sum(
+            g.astype(jnp.float32) * out.astype(jnp.float32), -1, keepdims=True
+        )
         dq = jnp.zeros(qf.shape, jnp.float32)
         dk_acc = jnp.zeros(kf.shape, jnp.float32)
         dv_acc = jnp.zeros(vf.shape, jnp.float32)
@@ -161,7 +164,7 @@ def _ring_attention_pallas_local(q, k, v, axis_name, causal, scale):
         for hop in range(R):
             dq_h, dk_h, dv_h = fa._pallas_flash_backward(
                 qf, kcur, vcur, g, out, lse3, causal and hop == 0, scale,
-                interpret=interp,
+                interpret=interp, delta=delta,
             )
             if hop_gate(hop):
                 ok = ((my - hop) % R) < my
@@ -186,7 +189,8 @@ def _ring_attention_pallas_local(q, k, v, axis_name, causal, scale):
         )
 
     core.defvjp(fwd_rule, bwd_rule)
-    return from_f(core(to_f(q), to_f(k), to_f(v)))
+    # hop partials stay fp32 end to end; one cast back at the boundary
+    return from_f(core(to_f(q), to_f(k), to_f(v)).astype(q.dtype))
 
 
 def _pallas_hops_viable(q, mesh, axis_name):

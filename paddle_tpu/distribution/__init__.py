@@ -1,0 +1,832 @@
+"""paddle.distribution — probability distributions (reference:
+python/paddle/distribution/ — Distribution base, Normal/Uniform/
+Categorical/Bernoulli/Beta/Dirichlet/..., kl_divergence + register_kl).
+
+TPU-native: every density/statistic is a jnp expression routed through the
+dispatch layer (differentiable, jit-traceable); sampling threads the global
+Generator key (framework/random.py) so it is reproducible under
+paddle.seed and becomes threaded state inside @to_static steps.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..framework.random import default_generator
+from ..ops.dispatch import apply, coerce, wrap
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+    "Beta", "Dirichlet", "Exponential", "Gamma", "Laplace", "LogNormal",
+    "Gumbel", "Multinomial", "Independent", "kl_divergence", "register_kl",
+]
+
+
+def _shape_tuple(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+class Distribution:
+    """Base class (reference: paddle.distribution.Distribution)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = _shape_tuple(batch_shape)
+        self._event_shape = _shape_tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    # -- interface ----------------------------------------------------------
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        import jax.numpy as jnp
+
+        return apply(lambda lp: jnp.exp(lp), [coerce(self.log_prob(value))], name="prob")
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+    def _key(self):
+        return default_generator.next_key()
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = coerce(loc, dtype="float32")
+        self.scale = coerce(scale, dtype="float32")
+        super().__init__(tuple(self.loc.shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale * self.scale
+
+    @property
+    def stddev(self):
+        return self.scale
+
+    def sample(self, shape=()):
+        import jax
+
+        shape = _shape_tuple(shape)
+        key = self._key()
+
+        def f(loc, sc):
+            eps = jax.random.normal(key, shape + loc.shape, loc.dtype)
+            return loc + sc * eps
+
+        out = apply(f, [self.loc, self.scale], name="normal_sample")
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        import jax
+
+        shape = _shape_tuple(shape)
+        key = self._key()
+        return apply(
+            lambda loc, sc: loc + sc * jax.random.normal(key, shape + loc.shape, loc.dtype),
+            [self.loc, self.scale],
+            name="normal_rsample",
+        )
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        return apply(
+            lambda v, loc, sc: -((v - loc) ** 2) / (2 * sc**2)
+            - jnp.log(sc)
+            - 0.5 * math.log(2 * math.pi),
+            [coerce(value), self.loc, self.scale],
+            name="normal_log_prob",
+        )
+
+    def entropy(self):
+        import jax.numpy as jnp
+
+        return apply(
+            lambda sc: 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(sc),
+            [self.scale],
+            name="normal_entropy",
+        )
+
+    def cdf(self, value):
+        import jax
+
+        return apply(
+            lambda v, loc, sc: jax.scipy.stats.norm.cdf(v, loc, sc),
+            [coerce(value), self.loc, self.scale],
+            name="normal_cdf",
+        )
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = coerce(loc, dtype="float32")
+        self.scale = coerce(scale, dtype="float32")
+        self._base = Normal(loc, scale)
+        super().__init__(tuple(self.loc.shape))
+
+    @property
+    def mean(self):
+        import jax.numpy as jnp
+
+        return apply(
+            lambda m, s: jnp.exp(m + s * s / 2), [self.loc, self.scale], name="lognormal_mean"
+        )
+
+    @property
+    def variance(self):
+        import jax.numpy as jnp
+
+        return apply(
+            lambda m, s: (jnp.exp(s * s) - 1) * jnp.exp(2 * m + s * s),
+            [self.loc, self.scale],
+            name="lognormal_var",
+        )
+
+    def sample(self, shape=()):
+        import jax.numpy as jnp
+
+        out = apply(lambda x: jnp.exp(x), [self._base.sample(shape)], name="exp")
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        import jax.numpy as jnp
+
+        return apply(lambda x: jnp.exp(x), [self._base.rsample(shape)], name="exp")
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        return apply(
+            lambda v, m, s: -((jnp.log(v) - m) ** 2) / (2 * s**2)
+            - jnp.log(v * s)
+            - 0.5 * math.log(2 * math.pi),
+            [coerce(value), self.loc, self.scale],
+            name="lognormal_log_prob",
+        )
+
+    def entropy(self):
+        import jax.numpy as jnp
+
+        return apply(
+            lambda m, s: m + 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+            [self.loc, self.scale],
+            name="lognormal_entropy",
+        )
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = coerce(low, dtype="float32")
+        self.high = coerce(high, dtype="float32")
+        super().__init__(tuple(self.low.shape))
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2.0
+
+    @property
+    def variance(self):
+        d = self.high - self.low
+        return d * d / 12.0
+
+    def sample(self, shape=()):
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        import jax
+
+        shape = _shape_tuple(shape)
+        key = self._key()
+        return apply(
+            lambda lo, hi: lo + (hi - lo) * jax.random.uniform(key, shape + lo.shape, lo.dtype),
+            [self.low, self.high],
+            name="uniform_sample",
+        )
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        return apply(
+            lambda v, lo, hi: jnp.where(
+                (v >= lo) & (v < hi), -jnp.log(hi - lo), -jnp.inf
+            ),
+            [coerce(value), self.low, self.high],
+            name="uniform_log_prob",
+        )
+
+    def entropy(self):
+        import jax.numpy as jnp
+
+        return apply(lambda lo, hi: jnp.log(hi - lo), [self.low, self.high], name="uniform_entropy")
+
+
+class Categorical(Distribution):
+    """logits: unnormalized log-probs [..., K] (reference accepts logits)."""
+
+    def __init__(self, logits=None, probs=None, name=None):
+        import jax.numpy as jnp
+
+        if (logits is None) == (probs is None):
+            raise ValueError("pass exactly one of logits / probs")
+        if probs is not None:
+            self.logits = apply(lambda p: jnp.log(p), [coerce(probs, dtype="float32")], name="log")
+        else:
+            self.logits = coerce(logits, dtype="float32")
+        super().__init__(tuple(self.logits.shape[:-1]))
+
+    @property
+    def probs(self):
+        import jax
+
+        return apply(lambda lg: jax.nn.softmax(lg, -1), [self.logits], name="softmax")
+
+    def sample(self, shape=()):
+        import jax
+
+        shape = _shape_tuple(shape)
+        key = self._key()
+        out = apply(
+            lambda lg: jax.random.categorical(key, lg, shape=shape + lg.shape[:-1]),
+            [self.logits],
+            name="categorical_sample",
+        )
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        import jax
+        import jax.numpy as jnp
+
+        return apply(
+            lambda v, lg: jnp.take_along_axis(
+                jax.nn.log_softmax(lg, -1), v[..., None].astype(jnp.int32), -1
+            )[..., 0],
+            [coerce(value), self.logits],
+            name="categorical_log_prob",
+        )
+
+    def entropy(self):
+        import jax
+        import jax.numpy as jnp
+
+        def f(lg):
+            logp = jax.nn.log_softmax(lg, -1)
+            return -(jnp.exp(logp) * logp).sum(-1)
+
+        return apply(f, [self.logits], name="categorical_entropy")
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_t = coerce(probs, dtype="float32")
+        super().__init__(tuple(self.probs_t.shape))
+
+    @property
+    def mean(self):
+        return self.probs_t
+
+    @property
+    def variance(self):
+        return self.probs_t * (1.0 - self.probs_t)
+
+    def sample(self, shape=()):
+        import jax
+
+        shape = _shape_tuple(shape)
+        key = self._key()
+        out = apply(
+            lambda p: jax.random.bernoulli(key, p, shape + p.shape).astype(p.dtype),
+            [self.probs_t],
+            name="bernoulli_sample",
+        )
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        eps = 1e-8
+
+        return apply(
+            lambda v, p: v * jnp.log(p + eps) + (1 - v) * jnp.log(1 - p + eps),
+            [coerce(value), self.probs_t],
+            name="bernoulli_log_prob",
+        )
+
+    def entropy(self):
+        import jax.numpy as jnp
+
+        eps = 1e-8
+        return apply(
+            lambda p: -(p * jnp.log(p + eps) + (1 - p) * jnp.log(1 - p + eps)),
+            [self.probs_t],
+            name="bernoulli_entropy",
+        )
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = coerce(alpha, dtype="float32")
+        self.beta = coerce(beta, dtype="float32")
+        super().__init__(tuple(self.alpha.shape))
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        import jax.numpy as jnp
+
+        return apply(
+            lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
+            [self.alpha, self.beta],
+            name="beta_var",
+        )
+
+    def sample(self, shape=()):
+        import jax
+
+        shape = _shape_tuple(shape)
+        key = self._key()
+        out = apply(
+            lambda a, b: jax.random.beta(key, a, b, shape + a.shape),
+            [self.alpha, self.beta],
+            name="beta_sample",
+        )
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        import jax.scipy.stats as jst
+
+        return apply(
+            lambda v, a, b: jst.beta.logpdf(v, a, b),
+            [coerce(value), self.alpha, self.beta],
+            name="beta_log_prob",
+        )
+
+    def entropy(self):
+        import jax.scipy.special as jsp
+
+        def f(a, b):
+            return (
+                jsp.betaln(a, b)
+                - (a - 1) * jsp.digamma(a)
+                - (b - 1) * jsp.digamma(b)
+                + (a + b - 2) * jsp.digamma(a + b)
+            )
+
+        return apply(f, [self.alpha, self.beta], name="beta_entropy")
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = coerce(concentration, dtype="float32")
+        shape = tuple(self.concentration.shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return self.concentration / self.concentration.sum(axis=-1, keepdim=True)
+
+    def sample(self, shape=()):
+        import jax
+
+        shape = _shape_tuple(shape)
+        key = self._key()
+        out = apply(
+            lambda c: jax.random.dirichlet(key, c, shape + c.shape[:-1]),
+            [self.concentration],
+            name="dirichlet_sample",
+        )
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+        import jax.scipy.special as jsp
+
+        def f(v, c):
+            # batched form: sum (c-1) log v - log B(c)
+            return ((c - 1) * jnp.log(v)).sum(-1) + jsp.gammaln(c.sum(-1)) - jsp.gammaln(c).sum(-1)
+
+        return apply(f, [coerce(value), self.concentration], name="dirichlet_log_prob")
+
+    def entropy(self):
+        import jax.numpy as jnp
+        import jax.scipy.special as jsp
+
+        def f(c):
+            c0 = c.sum(-1)
+            k = c.shape[-1]
+            logB = jsp.gammaln(c).sum(-1) - jsp.gammaln(c0)
+            return (
+                logB
+                + (c0 - k) * jsp.digamma(c0)
+                - ((c - 1) * jsp.digamma(c)).sum(-1)
+            )
+
+        return apply(f, [self.concentration], name="dirichlet_entropy")
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = coerce(rate, dtype="float32")
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return 1.0 / self.rate
+
+    @property
+    def variance(self):
+        return 1.0 / (self.rate * self.rate)
+
+    def sample(self, shape=()):
+        import jax
+
+        shape = _shape_tuple(shape)
+        key = self._key()
+        out = apply(
+            lambda r: jax.random.exponential(key, shape + r.shape, r.dtype) / r,
+            [self.rate],
+            name="exponential_sample",
+        )
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        return apply(
+            lambda v, r: jnp.where(v >= 0, jnp.log(r) - r * v, -jnp.inf),
+            [coerce(value), self.rate],
+            name="exponential_log_prob",
+        )
+
+    def entropy(self):
+        import jax.numpy as jnp
+
+        return apply(lambda r: 1.0 - jnp.log(r), [self.rate], name="exponential_entropy")
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = coerce(concentration, dtype="float32")
+        self.rate = coerce(rate, dtype="float32")
+        super().__init__(tuple(self.concentration.shape))
+
+    @property
+    def mean(self):
+        return self.concentration / self.rate
+
+    @property
+    def variance(self):
+        return self.concentration / (self.rate * self.rate)
+
+    def sample(self, shape=()):
+        import jax
+
+        shape = _shape_tuple(shape)
+        key = self._key()
+        out = apply(
+            lambda a, r: jax.random.gamma(key, a, shape + a.shape) / r,
+            [self.concentration, self.rate],
+            name="gamma_sample",
+        )
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        import jax.scipy.stats as jst
+
+        return apply(
+            lambda v, a, r: jst.gamma.logpdf(v, a, scale=1.0 / r),
+            [coerce(value), self.concentration, self.rate],
+            name="gamma_log_prob",
+        )
+
+    def entropy(self):
+        import jax.numpy as jnp
+        import jax.scipy.special as jsp
+
+        def f(a, r):
+            return a - jnp.log(r) + jsp.gammaln(a) + (1 - a) * jsp.digamma(a)
+
+        return apply(f, [self.concentration, self.rate], name="gamma_entropy")
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = coerce(loc, dtype="float32")
+        self.scale = coerce(scale, dtype="float32")
+        super().__init__(tuple(self.loc.shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return 2.0 * self.scale * self.scale
+
+    def sample(self, shape=()):
+        import jax
+
+        shape = _shape_tuple(shape)
+        key = self._key()
+        out = apply(
+            lambda m, s: m + s * jax.random.laplace(key, shape + m.shape, m.dtype),
+            [self.loc, self.scale],
+            name="laplace_sample",
+        )
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        return apply(
+            lambda v, m, s: -jnp.abs(v - m) / s - jnp.log(2 * s),
+            [coerce(value), self.loc, self.scale],
+            name="laplace_log_prob",
+        )
+
+    def entropy(self):
+        import jax.numpy as jnp
+
+        return apply(lambda s: 1.0 + jnp.log(2 * s), [self.scale], name="laplace_entropy")
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = coerce(loc, dtype="float32")
+        self.scale = coerce(scale, dtype="float32")
+        super().__init__(tuple(self.loc.shape))
+
+    _EULER = 0.5772156649015329
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * self._EULER
+
+    @property
+    def variance(self):
+        return (math.pi**2 / 6.0) * self.scale * self.scale
+
+    def sample(self, shape=()):
+        import jax
+
+        shape = _shape_tuple(shape)
+        key = self._key()
+        out = apply(
+            lambda m, s: m + s * jax.random.gumbel(key, shape + m.shape, m.dtype),
+            [self.loc, self.scale],
+            name="gumbel_sample",
+        )
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        def f(v, m, s):
+            z = (v - m) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+
+        return apply(f, [coerce(value), self.loc, self.scale], name="gumbel_log_prob")
+
+    def entropy(self):
+        import jax.numpy as jnp
+
+        return apply(
+            lambda s: jnp.log(s) + 1.0 + self._EULER, [self.scale], name="gumbel_entropy"
+        )
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_t = coerce(probs, dtype="float32")
+        shape = tuple(self.probs_t.shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return self.probs_t * float(self.total_count)
+
+    def sample(self, shape=()):
+        import jax
+        import jax.numpy as jnp
+
+        shape = _shape_tuple(shape)
+        key = self._key()
+        n = self.total_count
+
+        def f(p):
+            k = p.shape[-1]
+            draws = jax.random.categorical(
+                key, jnp.log(p), shape=(n,) + shape + p.shape[:-1]
+            )
+            return jax.nn.one_hot(draws, k, dtype=p.dtype).sum(0)
+
+        out = apply(f, [self.probs_t], name="multinomial_sample")
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+        import jax.scipy.special as jsp
+
+        def f(v, p):
+            return (
+                jsp.gammaln(v.sum(-1) + 1)
+                - jsp.gammaln(v + 1).sum(-1)
+                + (v * jnp.log(p)).sum(-1)
+            )
+
+        return apply(f, [coerce(value), self.probs_t], name="multinomial_log_prob")
+
+
+class Independent(Distribution):
+    """Reinterprets batch dims of a base distribution as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank=1):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bs = base.batch_shape
+        if not 0 <= self.rank <= len(bs):
+            raise ValueError(
+                f"reinterpreted_batch_rank {self.rank} exceeds the base "
+                f"distribution's batch rank {len(bs)} (batch_shape {bs})"
+            )
+        super().__init__(bs[: len(bs) - self.rank], bs[len(bs) - self.rank:])
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        for _ in range(self.rank):
+            lp = lp.sum(axis=-1)
+        return lp
+
+    def entropy(self):
+        e = self.base.entropy()
+        for _ in range(self.rank):
+            e = e.sum(axis=-1)
+        return e
+
+
+# ---------------------------------------------------------------------------
+# KL divergence registry (reference: paddle.distribution.kl_divergence /
+# register_kl dispatch by type pair)
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    def deco(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    for (tp, tq), fn in _KL_REGISTRY.items():
+        if isinstance(p, tp) and isinstance(q, tq):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})"
+    )
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    import jax.numpy as jnp
+
+    return apply(
+        lambda m1, s1, m2, s2: jnp.log(s2 / s1)
+        + (s1**2 + (m1 - m2) ** 2) / (2 * s2**2)
+        - 0.5,
+        [p.loc, p.scale, q.loc, q.scale],
+        name="kl_normal",
+    )
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    import jax
+    import jax.numpy as jnp
+
+    def f(lp, lq):
+        a = jax.nn.log_softmax(lp, -1)
+        b = jax.nn.log_softmax(lq, -1)
+        return (jnp.exp(a) * (a - b)).sum(-1)
+
+    return apply(f, [p.logits, q.logits], name="kl_categorical")
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    import jax.numpy as jnp
+
+    def f(lo1, hi1, lo2, hi2):
+        ok = (lo2 <= lo1) & (hi1 <= hi2)
+        return jnp.where(ok, jnp.log((hi2 - lo2) / (hi1 - lo1)), jnp.inf)
+
+    return apply(f, [p.low, p.high, q.low, q.high], name="kl_uniform")
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p, q):
+    import jax.numpy as jnp
+
+    eps = 1e-8
+
+    def f(a, b):
+        return a * (jnp.log(a + eps) - jnp.log(b + eps)) + (1 - a) * (
+            jnp.log(1 - a + eps) - jnp.log(1 - b + eps)
+        )
+
+    return apply(f, [p.probs_t, q.probs_t], name="kl_bernoulli")
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    import jax.scipy.special as jsp
+
+    def f(a1, b1, a2, b2):
+        return (
+            jsp.betaln(a2, b2)
+            - jsp.betaln(a1, b1)
+            + (a1 - a2) * jsp.digamma(a1)
+            + (b1 - b2) * jsp.digamma(b1)
+            + (a2 - a1 + b2 - b1) * jsp.digamma(a1 + b1)
+        )
+
+    return apply(f, [p.alpha, p.beta, q.alpha, q.beta], name="kl_beta")
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dir_dir(p, q):
+    import jax.scipy.special as jsp
+
+    def f(c1, c2):
+        s1 = c1.sum(-1)
+        return (
+            jsp.gammaln(s1)
+            - jsp.gammaln(c2.sum(-1))
+            - (jsp.gammaln(c1) - jsp.gammaln(c2)).sum(-1)
+            + ((c1 - c2) * (jsp.digamma(c1) - jsp.digamma(s1)[..., None])).sum(-1)
+        )
+
+    return apply(f, [p.concentration, q.concentration], name="kl_dirichlet")
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp_exp(p, q):
+    import jax.numpy as jnp
+
+    return apply(
+        lambda r1, r2: jnp.log(r1 / r2) + r2 / r1 - 1.0,
+        [p.rate, q.rate],
+        name="kl_exponential",
+    )

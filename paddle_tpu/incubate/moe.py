@@ -33,24 +33,39 @@ from ..distributed import mesh as _mesh
 from ..tensor import Tensor
 
 
-def gate_dispatch_tensors(lg, k, capacity):
+def gate_dispatch_tensors(lg, k, capacity, valid=None):
     """From router logits [T, E] build (dispatch [T, E, C], combine
     [T, E, C], aux_loss, stats).  Pure jax; shared by the dense path and
     the per-shard EP path.  Vectorized: lax.top_k picks the k experts at
     once; the static k-round unroll only sequences capacity priority
     (round 0 tokens claim slots before round 1), matching GShard.
 
+    valid: optional [T] bool — rows marked invalid (EP tail-batch padding)
+    make no slot claims and never appear in aux/drop accounting.
     stats: (dropped_assignments f32 scalar, expert_used i32 [E]) — the
     overflow accounting the reference's MoE layer exposes."""
     tokens, e = lg.shape
     probs = jax.nn.softmax(lg.astype(jnp.float32), -1)  # [T, E]
     # aux load-balance loss (GShard eq.): E * sum(me * ce)
-    me = probs.mean(0)
-    ce = jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=jnp.float32).mean(0)
+    if valid is not None:
+        v32 = valid.astype(jnp.float32)
+        n_valid = jnp.maximum(v32.sum(), 1.0)
+        me = (probs * v32[:, None]).sum(0) / n_valid
+        ce = (
+            jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=jnp.float32)
+            * v32[:, None]
+        ).sum(0) / n_valid
+    else:
+        me = probs.mean(0)
+        ce = jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=jnp.float32).mean(0)
     aux = (me * ce).sum() * e
 
     topv, topi = lax.top_k(probs, k)  # [T, k] each
     sel = jax.nn.one_hot(topi, e, dtype=jnp.int32)  # [T, k, E]
+    if valid is not None:
+        # pad rows claim no capacity slots and count no drops (their
+        # all-zero sel rows yield slot 0 -> fits True -> zero contribution)
+        sel = sel * valid.astype(jnp.int32)[:, None, None]
     disp = jnp.zeros((tokens, e, capacity), jnp.float32)
     comb = jnp.zeros((tokens, e, capacity), jnp.float32)
     used = jnp.zeros((e,), jnp.int32)
@@ -76,7 +91,7 @@ def gate_dispatch_tensors(lg, k, capacity):
     return disp, comb, aux, (dropped, used)
 
 
-def expert_choice_tensors(lg, capacity):
+def expert_choice_tensors(lg, capacity, valid=None):
     """Expert-choice routing (Zhou et al. 2022; the reference exposes it as
     a gate option): each EXPERT picks its top-`capacity` tokens, so load is
     balanced by construction (aux loss identically 0) and no token-side
@@ -85,23 +100,30 @@ def expert_choice_tensors(lg, capacity):
     stats) contract as gate_dispatch_tensors."""
     tokens, e = lg.shape
     capacity = min(capacity, tokens)  # an expert cannot pick more tokens than exist
+    if valid is not None:
+        # pad rows are unpickable: -inf affinity, zero softmax weight
+        lg = jnp.where(valid[:, None], lg.astype(jnp.float32), -jnp.inf)
     scores = jax.nn.softmax(lg.astype(jnp.float32), 0)  # over tokens, per expert
     g, i = lax.top_k(scores.T, capacity)  # [E, C] each: expert -> its tokens
     sel = jax.nn.one_hot(i, tokens, dtype=jnp.float32)  # [E, C, T]
     disp = jnp.transpose(sel, (2, 0, 1))  # [T, E, C]
     comb = disp * g[None]  # g: [E, C] broadcast over tokens
     covered = jnp.clip(disp.sum((1, 2)), 0.0, 1.0)  # token picked by >=1 expert
-    dropped = (1.0 - covered).sum()
+    if valid is not None:
+        v32 = valid.astype(jnp.float32)
+        dropped = (v32 * (1.0 - covered)).sum()  # uncovered REAL tokens only
+    else:
+        dropped = (1.0 - covered).sum()
     used = jnp.full((e,), capacity, jnp.int32)
     return disp, comb, jnp.zeros((), jnp.float32), (dropped, used)
 
 
-def route_tokens(lg, k, capacity, expert_choice):
+def route_tokens(lg, k, capacity, expert_choice, valid=None):
     """Single routing entry shared by the dense gate and the EP shard body
     (keeps the two paths from diverging)."""
     if expert_choice:
-        return expert_choice_tensors(lg, capacity)
-    return gate_dispatch_tensors(lg, k, capacity)
+        return expert_choice_tensors(lg, capacity, valid=valid)
+    return gate_dispatch_tensors(lg, k, capacity, valid=valid)
 
 
 class TopKGate(nn.Layer):
@@ -262,6 +284,7 @@ class MoELayer(nn.Layer):
             mesh=mesh,
             in_specs=(
                 P("ep", None),            # tokens
+                P("ep"),                  # valid-row mask (pad accounting)
                 P(None, None),            # gate weight (replicated)
                 P("ep", None, None),      # expert stacks sharded on ep
                 P("ep", None, None),
@@ -271,9 +294,11 @@ class MoELayer(nn.Layer):
             out_specs=(P("ep", None), P(), P(), P(None)),
             check_rep=False,
         )
-        def local(fl, wg, w1, b1, w2, b2):
+        def local(fl, vl, wg, w1, b1, w2, b2):
             lg = fl.astype(jnp.float32) @ wg.astype(jnp.float32)  # [T_l, E]
-            disp, comb, aux, (dropped, used) = route_tokens(lg, k, cap_local, ec)
+            disp, comb, aux, (dropped, used) = route_tokens(
+                lg, k, cap_local, ec, valid=None if pad == 0 else vl
+            )
             ein = jnp.einsum("td,tec->ecd", fl, disp.astype(fl.dtype))  # [E, C_l, D]
             # exchange: split experts across peers, gather their token slots
             ein = lax.all_to_all(ein, "ep", split_axis=0, concat_axis=1, tiled=True)
@@ -290,7 +315,9 @@ class MoELayer(nn.Layer):
 
         def f(fl, wg, w1, b1, w2, b2):
             fl = _mesh.constraint(fl, P("ep", None))
-            out, aux, dropped, used = local(fl, wg, w1, b1, w2, b2)
+            vl = jnp.arange(tokens_p) < tokens
+            vl = _mesh.constraint(vl, P("ep"))
+            out, aux, dropped, used = local(fl, vl, wg, w1, b1, w2, b2)
             if pad:
                 out = out[:tokens]
             return out, aux, dropped, used
@@ -301,5 +328,6 @@ class MoELayer(nn.Layer):
             multi=True,
             name="moe_ep_a2a",
         )
-        self._set_stats(dropped, used, tokens_p)
+        # stats over REAL tokens only (pads make no claims and count none)
+        self._set_stats(dropped, used, tokens)
         return out, aux

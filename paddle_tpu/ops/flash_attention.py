@@ -62,7 +62,7 @@ def _blk_mask(s, q_start, k_start, block_q, block_k, causal, sq=None, sk=None):
 
 def _flash_fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-    *, causal, scale, block_q, block_k, seg_refs=(),
+    *, causal, scale, block_q, block_k, seg_refs=(), carry_refs=(),
 ):
     """Grid (bh blocks, q blocks, k blocks), k innermost: one K/V tile per
     step, (m, l, acc) carried in VMEM scratch across the sequential grid.
@@ -79,9 +79,18 @@ def _flash_fwd_kernel(
 
     @pl.when(ki == 0)
     def _init():
-        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
-        l_scr[...] = jnp.zeros_like(l_scr)
-        acc_scr[...] = jnp.zeros_like(acc_scr)
+        if carry_refs:
+            # continuation: previous partial (out, lse) is algebraically a
+            # pseudo-block with m=lse, l=1, acc=out — the ring-attention
+            # hop merge happens IN-KERNEL instead of as a separate
+            # elementwise chain per hop
+            m_scr[...] = carry_refs[1][...].astype(jnp.float32)
+            l_scr[...] = jnp.ones_like(l_scr)
+            acc_scr[...] = carry_refs[0][...].astype(jnp.float32)
+        else:
+            m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+            l_scr[...] = jnp.zeros_like(l_scr)
+            acc_scr[...] = jnp.zeros_like(acc_scr)
 
     # causal: blocks strictly above the diagonal contribute nothing
     needed = (k_start <= q_start + block_q - 1) if causal else True
@@ -148,20 +157,24 @@ def _pick_bh_block(bh, n_heads, block_q, block_k, d, has_segments):
 
 
 def _pallas_flash_forward(q, k, v, causal, scale, segments=None, n_heads=1,
-                          block_q=1024, block_k=1024, interpret=False):
+                          block_q=1024, block_k=1024, interpret=False,
+                          carry=None, out_dtype=None):
     """q,k,v: [bh, seq, d]; segments: optional [b, seq, 1] int32 (shared
-    across the head dim via the index map).
+    across the head dim via the index map); carry: optional
+    (out_prev [bh, seq, d], lse_prev [bh, seq, 1]) continuation state —
+    this call merges its blocks ONTO the carry (ring-attention hops).
     Returns (out [bh, seq, d], lse [bh, seq, 1] f32)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     bh, seq_len, d = q.shape
+    k_len = k.shape[1]
     # block sizes must divide the sequence (the caller guarantees s % 128
     # == 0, so 128 always works)
     block_q = _pick_block(seq_len, block_q)
-    block_k = _pick_block(seq_len, block_k)
+    block_k = _pick_block(k_len, block_k)
     bb = _pick_bh_block(bh, n_heads, block_q, block_k, d, segments is not None)
-    grid = (bh // bb, seq_len // block_q, seq_len // block_k)
+    grid = (bh // bb, seq_len // block_q, k_len // block_k)
 
     in_specs = [
         pl.BlockSpec((bb, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -176,17 +189,27 @@ def _pallas_flash_forward(q, k, v, causal, scale, segments=None, n_heads=1,
             pl.BlockSpec((None, block_k, 1), lambda b, i, j: ((b * bb) // n_heads, j, 0)),
         ]
         args += [segments, segments]
+    if carry is not None:
+        in_specs += [
+            pl.BlockSpec((bb, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((bb, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ]
+        args += [carry[0], carry[1]]
 
     def kernel(q_ref, k_ref, v_ref, *rest):
         if segments is not None:
             seg_refs, rest = rest[:2], rest[2:]
         else:
             seg_refs = ()
+        if carry is not None:
+            carry_refs, rest = rest[:2], rest[2:]
+        else:
+            carry_refs = ()
         o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
         _flash_fwd_kernel(
             q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             causal=causal, scale=scale, block_q=block_q, block_k=block_k,
-            seg_refs=seg_refs,
+            seg_refs=seg_refs, carry_refs=carry_refs,
         )
 
     return pl.pallas_call(
@@ -199,7 +222,7 @@ def _pallas_flash_forward(q, k, v, causal, scale, segments=None, n_heads=1,
             pl.BlockSpec((bb, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(q.shape, out_dtype or q.dtype),
             jax.ShapeDtypeStruct((bh, seq_len, 1), jnp.float32),
         ],
         scratch_shapes=[
@@ -323,19 +346,25 @@ def _flash_bwd_dq_kernel(
 
 
 def _pallas_flash_backward(q, k, v, g, out, lse, causal, scale, segments=None,
-                           n_heads=1, block_q=1024, block_k=1024, interpret=False):
-    """All [bh, s, d] (lse [bh, s, 1] f32; segments [b, s, 1]).
+                           n_heads=1, block_q=1024, block_k=1024, interpret=False,
+                           delta=None):
+    """q/g/out/lse: [bh, sq, ...]; k/v: [bh, sk, d] — rectangular k is
+    allowed for the non-causal ring-hop case (causal assumes sq == sk).
+    delta: optional precomputed rowsum(g*out) [bh, sq, 1] — the ring path
+    computes it ONCE for all hops instead of once per hop.
     Returns (dq, dk, dv)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     bh, s, d = q.shape
+    sk = k.shape[1]
     block_q = _pick_block(s, block_q)
-    block_k = _pick_block(s, block_k)
+    block_k = _pick_block(sk, block_k)
     bb = _pick_bh_block(bh, n_heads, block_q, block_k, d, segments is not None)
-    delta = jnp.sum(
-        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
-    )  # [bh, s, 1]
+    if delta is None:
+        delta = jnp.sum(
+            g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
+        )  # [bh, s, 1]
 
     common = dict(causal=causal, scale=scale, block_q=block_q, block_k=block_k)
 
@@ -366,7 +395,7 @@ def _pallas_flash_backward(q, k, v, g, out, lse, causal, scale, segments=None,
 
     dk, dv = pl.pallas_call(
         dkdv_kernel,
-        grid=(bh // bb, s // block_k, s // block_q),
+        grid=(bh // bb, sk // block_k, s // block_q),
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((bb, block_k, d), lambda b, i, j: (b, i, 0)),
@@ -410,7 +439,7 @@ def _pallas_flash_backward(q, k, v, g, out, lse, causal, scale, segments=None,
 
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(bh // bb, s // block_q, s // block_k),
+        grid=(bh // bb, s // block_q, sk // block_k),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((bb, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),

@@ -37,6 +37,9 @@ class SparseCooTensor:
         x = coerce(x)
         return SparseCooTensor(jsparse.BCOO.fromdense(x._data))
 
+    def to_sparse_csr(self):
+        return to_sparse_csr(self)
+
     # -- reference surface ------------------------------------------------
     @property
     def shape(self):
@@ -96,6 +99,94 @@ class SparseCooTensor:
         return f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, dtype={self.dtype})"
 
 
+class SparseCsrTensor:
+    """CSR sparse tensor (reference: paddle.sparse.sparse_csr_tensor /
+    SparseCsrTensor over phi sparse CSR kernels).  Backed by
+    jax.experimental.sparse.BCSR; `.crows()` / `.cols()` / `.values()`
+    follow the reference API (2-D only, the reference's common case)."""
+
+    def __init__(self, bcsr):
+        self._bcsr = bcsr
+
+    @staticmethod
+    def from_dense(x):
+        x = coerce(x)
+        return SparseCsrTensor(jsparse.BCSR.fromdense(x._data))
+
+    @property
+    def shape(self):
+        return list(self._bcsr.shape)
+
+    @property
+    def dtype(self):
+        from .framework import core as _core
+
+        return _core.convert_dtype(self._bcsr.dtype)
+
+    @property
+    def nnz(self):
+        return int(self._bcsr.nse)
+
+    def crows(self):
+        return wrap(self._bcsr.indptr)
+
+    def cols(self):
+        return wrap(self._bcsr.indices)
+
+    def values(self):
+        return wrap(self._bcsr.data)
+
+    def to_dense(self):
+        return wrap(self._bcsr.todense())
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return SparseCooTensor(self._bcsr.to_bcoo())
+
+    def matmul(self, dense):
+        """sparse [m, k] @ dense [k, n] -> dense Tensor [m, n]."""
+        d = coerce(dense)
+        return wrap(self._bcsr @ d._data)
+
+    def _map_values(self, fn):
+        return SparseCsrTensor(
+            jsparse.BCSR(
+                (fn(self._bcsr.data), self._bcsr.indices, self._bcsr.indptr),
+                shape=self._bcsr.shape,
+            )
+        )
+
+    def __add__(self, other):
+        if isinstance(other, SparseCsrTensor):
+            # route through BCOO (BCSR has no direct add), back to CSR
+            s = (self._bcsr.to_bcoo() + other._bcsr.to_bcoo()).sum_duplicates()
+            return SparseCsrTensor(jsparse.BCSR.from_bcoo(s))
+        return wrap(self._bcsr.todense() + coerce(other)._data)
+
+    def __mul__(self, scalar):
+        return self._map_values(lambda v: v * scalar)
+
+    def __repr__(self):
+        return f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, dtype={self.dtype})"
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None, stop_gradient=True):
+    """Build from CSR triplets (reference signature)."""
+    indptr = coerce(crows)._data.astype(jnp.int32)
+    indices = coerce(cols)._data.astype(jnp.int32)
+    vals = coerce(values)._data
+    if dtype is not None:
+        from .framework import core as _core
+
+        vals = vals.astype(_core.to_jax_dtype(dtype))
+    return SparseCsrTensor(jsparse.BCSR((vals, indices, indptr), shape=tuple(shape)))
+
+
+def to_sparse_csr(x):
+    if isinstance(x, SparseCooTensor):
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(x._bcoo.sum_duplicates()))
+    return SparseCsrTensor.from_dense(x)
+
+
 def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_gradient=True):
     """Build from [ndim, nnz] indices + [nnz] values (reference signature)."""
     idx = coerce(indices)._data.astype(jnp.int32)
@@ -114,7 +205,9 @@ def to_sparse_coo(x, sparse_dim=None):
 
 
 def to_dense(x):
-    return x.to_dense() if isinstance(x, SparseCooTensor) else coerce(x)
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        return x.to_dense()
+    return coerce(x)
 
 
 def add(a, b):
@@ -122,7 +215,7 @@ def add(a, b):
 
 
 def matmul(a, b):
-    if isinstance(a, SparseCooTensor):
+    if isinstance(a, (SparseCooTensor, SparseCsrTensor)):
         return a.matmul(b)
     return coerce(a).matmul(coerce(b))
 

@@ -1,0 +1,232 @@
+"""paddle.distribution parity (round-4 verdict missing #1).  Oracle:
+torch.distributions (CPU torch is in the image) for densities/entropy/KL;
+moment checks for sampling."""
+
+import numpy as np
+import pytest
+import torch
+import torch.distributions as td
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+RNG = np.random.RandomState(0)
+
+
+class TestDensities:
+    def test_normal(self):
+        loc, sc = np.array([0.5, -1.0], np.float32), np.array([1.2, 0.3], np.float32)
+        v = np.array([0.1, -0.8], np.float32)
+        p = D.Normal(t(loc), t(sc))
+        ref = td.Normal(torch.tensor(loc), torch.tensor(sc))
+        np.testing.assert_allclose(
+            p.log_prob(t(v)).numpy(), ref.log_prob(torch.tensor(v)).numpy(), rtol=1e-5
+        )
+        np.testing.assert_allclose(p.entropy().numpy(), ref.entropy().numpy(), rtol=1e-5)
+        np.testing.assert_allclose(p.mean.numpy(), loc)
+        np.testing.assert_allclose(p.variance.numpy(), sc**2, rtol=1e-6)
+        np.testing.assert_allclose(
+            p.cdf(t(v)).numpy(), ref.cdf(torch.tensor(v)).numpy(), rtol=1e-5
+        )
+
+    def test_uniform(self):
+        lo, hi = np.float32(-1.0), np.float32(3.0)
+        p = D.Uniform(t(lo), t(hi))
+        ref = td.Uniform(torch.tensor(lo), torch.tensor(hi))
+        v = np.float32(0.7)
+        np.testing.assert_allclose(
+            p.log_prob(t(v)).numpy(), ref.log_prob(torch.tensor(v)).numpy(), rtol=1e-6
+        )
+        np.testing.assert_allclose(p.entropy().numpy(), ref.entropy().numpy(), rtol=1e-6)
+        assert p.log_prob(t(np.float32(9.0))).numpy() == -np.inf
+
+    def test_categorical(self):
+        lg = RNG.randn(3, 5).astype(np.float32)
+        v = RNG.randint(0, 5, (3,))
+        p = D.Categorical(logits=t(lg))
+        ref = td.Categorical(logits=torch.tensor(lg))
+        np.testing.assert_allclose(
+            p.log_prob(paddle.to_tensor(v.astype(np.int64))).numpy(),
+            ref.log_prob(torch.tensor(v)).numpy(),
+            rtol=1e-5,
+        )
+        np.testing.assert_allclose(p.entropy().numpy(), ref.entropy().numpy(), rtol=1e-5)
+        np.testing.assert_allclose(p.probs.numpy(), ref.probs.numpy(), rtol=1e-5)
+
+    def test_bernoulli(self):
+        pr = np.array([0.2, 0.7], np.float32)
+        v = np.array([1.0, 0.0], np.float32)
+        p = D.Bernoulli(t(pr))
+        ref = td.Bernoulli(torch.tensor(pr))
+        np.testing.assert_allclose(
+            p.log_prob(t(v)).numpy(), ref.log_prob(torch.tensor(v)).numpy(), rtol=1e-4
+        )
+        np.testing.assert_allclose(p.entropy().numpy(), ref.entropy().numpy(), rtol=1e-4)
+
+    def test_beta(self):
+        a, b = np.array([2.0, 0.5], np.float32), np.array([3.0, 1.5], np.float32)
+        v = np.array([0.3, 0.6], np.float32)
+        p = D.Beta(t(a), t(b))
+        ref = td.Beta(torch.tensor(a), torch.tensor(b))
+        np.testing.assert_allclose(
+            p.log_prob(t(v)).numpy(), ref.log_prob(torch.tensor(v)).numpy(), rtol=1e-4
+        )
+        np.testing.assert_allclose(p.entropy().numpy(), ref.entropy().numpy(), rtol=1e-4)
+        np.testing.assert_allclose(p.mean.numpy(), (a / (a + b)), rtol=1e-5)
+
+    def test_dirichlet(self):
+        c = np.array([[2.0, 3.0, 0.5], [1.0, 1.0, 1.0]], np.float32)
+        v = np.array([[0.2, 0.5, 0.3], [0.1, 0.1, 0.8]], np.float32)
+        p = D.Dirichlet(t(c))
+        ref = td.Dirichlet(torch.tensor(c))
+        np.testing.assert_allclose(
+            p.log_prob(t(v)).numpy(), ref.log_prob(torch.tensor(v)).numpy(), rtol=1e-4
+        )
+        np.testing.assert_allclose(p.entropy().numpy(), ref.entropy().numpy(), rtol=1e-4)
+
+    def test_exponential_gamma_laplace_gumbel_lognormal(self):
+        v = np.array([0.4, 1.7], np.float32)
+        pairs = [
+            (D.Exponential(t([1.5, 0.5])), td.Exponential(torch.tensor([1.5, 0.5]))),
+            (
+                D.Gamma(t([2.0, 3.0]), t([1.0, 0.5])),
+                td.Gamma(torch.tensor([2.0, 3.0]), torch.tensor([1.0, 0.5])),
+            ),
+            (
+                D.Laplace(t([0.0, 1.0]), t([1.0, 2.0])),
+                td.Laplace(torch.tensor([0.0, 1.0]), torch.tensor([1.0, 2.0])),
+            ),
+            (
+                D.Gumbel(t([0.0, 1.0]), t([1.0, 2.0])),
+                td.Gumbel(torch.tensor([0.0, 1.0]), torch.tensor([1.0, 2.0])),
+            ),
+            (
+                D.LogNormal(t([0.0, 0.5]), t([1.0, 0.7])),
+                td.LogNormal(torch.tensor([0.0, 0.5]), torch.tensor([1.0, 0.7])),
+            ),
+        ]
+        for p, ref in pairs:
+            np.testing.assert_allclose(
+                p.log_prob(t(v)).numpy(),
+                ref.log_prob(torch.tensor(v)).numpy(),
+                rtol=1e-4,
+                err_msg=type(p).__name__,
+            )
+            np.testing.assert_allclose(
+                p.entropy().numpy(), ref.entropy().numpy(), rtol=1e-4,
+                err_msg=type(p).__name__,
+            )
+
+    def test_multinomial(self):
+        pr = np.array([0.2, 0.3, 0.5], np.float32)
+        v = np.array([2.0, 3.0, 5.0], np.float32)
+        p = D.Multinomial(10, t(pr))
+        ref = td.Multinomial(10, torch.tensor(pr))
+        np.testing.assert_allclose(
+            p.log_prob(t(v)).numpy(), ref.log_prob(torch.tensor(v)).numpy(), rtol=1e-4
+        )
+
+    def test_independent(self):
+        loc = RNG.randn(4, 3).astype(np.float32)
+        p = D.Independent(D.Normal(t(loc), t(np.ones_like(loc))), 1)
+        ref = td.Independent(
+            td.Normal(torch.tensor(loc), torch.ones(4, 3)), 1
+        )
+        v = RNG.randn(4, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            p.log_prob(t(v)).numpy(), ref.log_prob(torch.tensor(v)).numpy(), rtol=1e-5
+        )
+        assert p.event_shape == (3,)
+        with pytest.raises(ValueError, match="batch rank"):
+            D.Independent(D.Normal(t(loc), t(np.ones_like(loc))), 3)
+
+
+class TestKL:
+    def test_kl_pairs(self):
+        cases = [
+            (
+                D.Normal(t([0.0]), t([1.0])), D.Normal(t([1.0]), t([2.0])),
+                td.Normal(torch.tensor([0.0]), torch.tensor([1.0])),
+                td.Normal(torch.tensor([1.0]), torch.tensor([2.0])),
+            ),
+            (
+                D.Categorical(logits=t([[1.0, 2.0, 0.5]])),
+                D.Categorical(logits=t([[0.0, 0.0, 0.0]])),
+                td.Categorical(logits=torch.tensor([[1.0, 2.0, 0.5]])),
+                td.Categorical(logits=torch.tensor([[0.0, 0.0, 0.0]])),
+            ),
+            (
+                D.Bernoulli(t([0.3])), D.Bernoulli(t([0.6])),
+                td.Bernoulli(torch.tensor([0.3])), td.Bernoulli(torch.tensor([0.6])),
+            ),
+            (
+                D.Beta(t([2.0]), t([3.0])), D.Beta(t([1.0]), t([1.0])),
+                td.Beta(torch.tensor([2.0]), torch.tensor([3.0])),
+                td.Beta(torch.tensor([1.0]), torch.tensor([1.0])),
+            ),
+            (
+                D.Dirichlet(t([[2.0, 3.0, 1.0]])), D.Dirichlet(t([[1.0, 1.0, 1.0]])),
+                td.Dirichlet(torch.tensor([[2.0, 3.0, 1.0]])),
+                td.Dirichlet(torch.tensor([[1.0, 1.0, 1.0]])),
+            ),
+            (
+                D.Exponential(t([2.0])), D.Exponential(t([0.5])),
+                td.Exponential(torch.tensor([2.0])), td.Exponential(torch.tensor([0.5])),
+            ),
+        ]
+        for p, q, tp, tq in cases:
+            np.testing.assert_allclose(
+                D.kl_divergence(p, q).numpy(),
+                td.kl_divergence(tp, tq).numpy(),
+                rtol=1e-4,
+                err_msg=type(p).__name__,
+            )
+
+    def test_unregistered_raises(self):
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(D.Normal(t([0.0]), t([1.0])), D.Bernoulli(t([0.5])))
+
+
+class TestSampling:
+    def test_moments_and_seed(self):
+        paddle.seed(7)
+        p = D.Normal(t([1.0]), t([2.0]))
+        s = p.sample((20000,)).numpy()
+        assert abs(s.mean() - 1.0) < 0.1 and abs(s.std() - 2.0) < 0.1
+        paddle.seed(7)
+        s2 = D.Normal(t([1.0]), t([2.0])).sample((20000,)).numpy()
+        np.testing.assert_array_equal(s, s2)  # paddle.seed reproducibility
+
+    def test_categorical_frequencies(self):
+        paddle.seed(1)
+        p = D.Categorical(probs=t([0.1, 0.2, 0.7]))
+        s = p.sample((20000,)).numpy()
+        freq = np.bincount(s, minlength=3) / len(s)
+        np.testing.assert_allclose(freq, [0.1, 0.2, 0.7], atol=0.02)
+
+    def test_rsample_differentiable(self):
+        paddle.seed(2)
+        loc = t([0.5])
+        loc.stop_gradient = False
+        p = D.Normal(loc, t([1.0]))
+        out = p.rsample((64,))
+        out.sum().backward()
+        np.testing.assert_allclose(loc.grad.numpy(), [64.0])  # d(loc+eps*sc)/dloc
+
+    def test_multinomial_counts(self):
+        paddle.seed(3)
+        p = D.Multinomial(50, t([0.5, 0.5]))
+        s = p.sample().numpy()
+        assert s.sum() == 50
+
+    def test_beta_dirichlet_support(self):
+        paddle.seed(4)
+        b = D.Beta(t([2.0]), t([3.0])).sample((100,)).numpy()
+        assert ((b > 0) & (b < 1)).all()
+        d = D.Dirichlet(t([2.0, 1.0, 0.5])).sample((100,)).numpy()
+        np.testing.assert_allclose(d.sum(-1), 1.0, rtol=1e-5)

@@ -161,14 +161,17 @@ class TestMoE:
         assert losses[-1] < losses[0]
 
     def test_ep_ragged_tokens_padded(self):
-        # tokens % ep != 0 must pad, not raise (varlen tail batch)
+        # tokens % ep != 0 must pad, not raise (varlen tail batch); pad rows
+        # make no slot claims so the telemetry reports REAL drops only
         pmesh.build_mesh(ep=4)
         paddle.seed(4)
         moe = MoELayer(16, 32, num_experts=8, top_k=2, capacity_factor=8.0)
         x = t(np.random.randn(3, 7, 16).astype(np.float32))  # 21 tokens, ep=4
         out = moe(x)
         assert out.shape == [3, 7, 16]
-        assert moe.drop_stats is not None
+        # ample capacity: zero drops even though 3 pad rows were routed
+        assert float(moe.drop_stats["dropped_tokens"].numpy()) == 0.0
+        assert float(moe.drop_stats["dropped_fraction"].numpy()) == 0.0
 
     def test_ep_sharded_experts(self):
         pmesh.build_mesh(mp=4)

@@ -128,3 +128,68 @@ class TestFP8:
         out = F.linear_fp8(t(x), t(w), t(bias)).astype("float32").numpy()
         ref = x @ w + bias
         assert np.abs(out - ref).max() / np.abs(ref).max() < 0.12
+
+
+class TestSparseCsr:
+    def _dense(self):
+        d = np.zeros((4, 6), np.float32)
+        d[0, 1] = 2.0
+        d[1, 4] = -3.0
+        d[2, 0] = 1.5
+        d[3, 5] = 4.0
+        d[3, 0] = -1.0
+        return d
+
+    def test_from_dense_roundtrip_and_fields(self):
+        from paddle_tpu import sparse
+
+        d = self._dense()
+        s = sparse.to_sparse_csr(t(d))
+        assert s.nnz == 5
+        np.testing.assert_allclose(s.to_dense().numpy(), d)
+        # CSR invariants: crows is [rows+1] monotone ending at nnz
+        crows = s.crows().numpy()
+        assert crows.shape == (5,)
+        assert crows[0] == 0 and crows[-1] == 5
+        assert (np.diff(crows) >= 0).all()
+        assert s.cols().numpy().max() < 6
+
+    def test_constructor_matches_reference_signature(self):
+        from paddle_tpu import sparse
+
+        crows = np.array([0, 1, 2, 3, 5], np.int64)
+        cols = np.array([1, 4, 0, 0, 5], np.int64)
+        vals = np.array([2.0, -3.0, 1.5, -1.0, 4.0], np.float32)
+        s = sparse.sparse_csr_tensor(t(crows), t(cols), t(vals), [4, 6])
+        d = s.to_dense().numpy()
+        assert d[0, 1] == 2.0 and d[3, 5] == 4.0 and d[3, 0] == -1.0
+
+    def test_csr_matmul(self):
+        from paddle_tpu import sparse
+
+        d = self._dense()
+        rng = np.random.RandomState(0)
+        m = rng.rand(6, 3).astype(np.float32)
+        s = sparse.to_sparse_csr(t(d))
+        np.testing.assert_allclose(s.matmul(t(m)).numpy(), d @ m, rtol=1e-5)
+
+    def test_coo_csr_conversions(self):
+        from paddle_tpu import sparse
+
+        d = self._dense()
+        coo = sparse.to_sparse_coo(t(d))
+        csr = coo.to_sparse_csr()
+        np.testing.assert_allclose(csr.to_dense().numpy(), d)
+        back = csr.to_sparse_coo()
+        np.testing.assert_allclose(back.to_dense().numpy(), d)
+
+    def test_csr_add_and_value_ops(self):
+        from paddle_tpu import sparse
+
+        d = self._dense()
+        s = sparse.to_sparse_csr(t(d))
+        two = s + s
+        np.testing.assert_allclose(two.to_dense().numpy(), 2 * d)
+        np.testing.assert_allclose((s * 3.0).to_dense().numpy(), 3 * d)
+        relu_d = sparse.to_sparse_csr(t(d))._map_values(lambda v: v.clip(0))
+        np.testing.assert_allclose(relu_d.to_dense().numpy(), np.maximum(d, 0))

@@ -13,6 +13,8 @@ from __future__ import annotations
 import functools
 import math
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -21,6 +23,10 @@ from ....ops.dispatch import apply, coerce
 from ... import mesh as _mesh
 
 _NEG_INF = -1e30
+
+# per-device budget for the gathered-KV causal CP form; beyond it the KV
+# rotates hop-by-hop around the ring instead
+_GATHERED_KV_MAX_BYTES = 256 * 1024 * 1024
 
 
 def _block_attn(q, k, v, scale, mask):
@@ -193,6 +199,267 @@ def _ring_attention_pallas_local(q, k, v, axis_name, causal, scale):
     return from_f(core(to_f(q), to_f(k), to_f(v)).astype(q.dtype))
 
 
+def _ring_attention_zigzag_local(q, k, v, axis_name, scale):
+    """Load-balanced CAUSAL ring (zig-zag chunk layout, the production ring
+    -attention fix for causal imbalance): the sequence is split into 2R
+    chunks and device i holds chunks (i, 2R-1-i) — every device then owns
+    exactly 2R+1 causal c x c blocks, so ring wall time is the BALANCED
+    per-device cost instead of the last device's full row.
+
+    Runs INSIDE shard_map on the zig-zag-permuted layout: local shards are
+    [b, 2c, heads, d] with rows [chunk_lo | chunk_hi].  Per hop h >= 1
+    exactly two half-chunk blocks compute (uniform shapes; which q/kv half
+    feeds the second block is a traced select on h <= my_idx), merged
+    through the Pallas (out, lse) carry.  Ring-level FA-2 backward: dk/dv
+    partial sums ride with their kv pair until home."""
+    from ....ops import flash_attention as fa
+
+    R = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % R) for i in range(R)]
+    b, two_c, h, d = q.shape
+    c = two_c // 2
+    interp = fa._FORCE_INTERPRET
+
+    def to_f(x):  # [b, c, h, d] -> [b*h, c, d]
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, -1, d)
+
+    def from_f(x):
+        return jnp.transpose(x.reshape(b, h, -1, d), (0, 2, 1, 3))
+
+    def halves(xf):
+        return xf[:, :c], xf[:, c:]
+
+    def _fwd(qf, kf, vf):
+        my = jax.lax.axis_index(axis_name)
+        q_lo, q_hi = halves(qf)
+        state = {  # per-half carry: (out f32, lse3)
+            "lo": None,
+            "hi": None,
+        }
+
+        def merge(tag, kb, vb, qb, causal):
+            carry = state[tag]
+            o, l3 = fa._pallas_flash_forward(
+                qb, kb, vb, causal, scale, interpret=interp,
+                carry=carry, out_dtype=jnp.float32,
+            )
+            state[tag] = (o, l3)
+            return o, l3
+
+        kcur, vcur = kf, vf
+        for hop in range(R):
+            k_lo, k_hi = halves(kcur)
+            v_lo, v_hi = halves(vcur)
+            if hop == 0:
+                merge("lo", k_lo, v_lo, q_lo, True)     # diagonal
+                merge("hi", k_lo, v_lo, q_hi, False)    # hi sees lo fully
+                merge("hi", k_hi, v_hi, q_hi, True)     # diagonal
+            else:
+                # peer j = (my - hop) mod R.  h <= my  <=>  j < my:
+                #   q_lo attends kv_lo fully; q_hi/kv_hi skipped
+                # else (j > my): q_hi attends kv_hi fully; q_lo skipped
+                sel = hop <= my
+                merge("hi", k_lo, v_lo, q_hi, False)    # always valid
+                qb = jnp.where(sel, q_lo, q_hi)
+                kb = jnp.where(sel, k_lo, k_hi)
+                vb = jnp.where(sel, v_lo, v_hi)
+                lo_c = state["lo"]
+                hi_c = state["hi"]
+                carry = (
+                    jnp.where(sel, lo_c[0], hi_c[0]),
+                    jnp.where(sel, lo_c[1], hi_c[1]),
+                )
+                o, l3 = fa._pallas_flash_forward(
+                    qb, kb, vb, False, scale, interpret=interp,
+                    carry=carry, out_dtype=jnp.float32,
+                )
+                state["lo"] = (
+                    jnp.where(sel, o, lo_c[0]),
+                    jnp.where(sel, l3, lo_c[1]),
+                )
+                state["hi"] = (
+                    jnp.where(sel, hi_c[0], o),
+                    jnp.where(sel, hi_c[1], l3),
+                )
+            if hop < R - 1:
+                kcur = jax.lax.ppermute(kcur, axis_name, perm)
+                vcur = jax.lax.ppermute(vcur, axis_name, perm)
+        out = jnp.concatenate([state["lo"][0], state["hi"][0]], axis=1)
+        lse = jnp.concatenate([state["lo"][1], state["hi"][1]], axis=1)
+        return out, lse
+
+    @jax.custom_vjp
+    def core(qf, kf, vf):
+        return _fwd(qf, kf, vf)[0]
+
+    def fwd_rule(qf, kf, vf):
+        out, lse = _fwd(qf, kf, vf)
+        return out, (qf, kf, vf, out, lse)
+
+    def bwd_rule(res, g):
+        qf, kf, vf, out, lse = res
+        my = jax.lax.axis_index(axis_name)
+        q_lo, q_hi = halves(qf)
+        g_lo, g_hi = halves(g)
+        out_lo, out_hi = halves(out)
+        lse_lo, lse_hi = halves(lse)
+        delta = jnp.sum(
+            g.astype(jnp.float32) * out.astype(jnp.float32), -1, keepdims=True
+        )
+        d_lo, d_hi = halves(delta)
+        dq_lo = jnp.zeros(q_lo.shape, jnp.float32)
+        dq_hi = jnp.zeros(q_hi.shape, jnp.float32)
+        dkv_acc = jnp.zeros((4,) + (b * h, c, d), jnp.float32)  # dk_lo,dk_hi,dv_lo,dv_hi
+        kcur, vcur = kf, vf
+
+        def block_bwd(qb, kb, vb, gb, ob, lb, db, causal):
+            return fa._pallas_flash_backward(
+                qb, kb, vb, gb, ob, lb, causal, scale,
+                interpret=interp, delta=db,
+            )
+
+        for hop in range(R):
+            k_lo, k_hi = halves(kcur)
+            v_lo, v_hi = halves(vcur)
+            dk_lo, dk_hi, dv_lo, dv_hi = dkv_acc
+            if hop == 0:
+                dq1, dk1, dv1 = block_bwd(q_lo, k_lo, v_lo, g_lo, out_lo, lse_lo, d_lo, True)
+                dq2, dk2, dv2 = block_bwd(q_hi, k_lo, v_lo, g_hi, out_hi, lse_hi, d_hi, False)
+                dq3, dk3, dv3 = block_bwd(q_hi, k_hi, v_hi, g_hi, out_hi, lse_hi, d_hi, True)
+                dq_lo = dq_lo + dq1.astype(jnp.float32)
+                dq_hi = dq_hi + (dq2 + dq3).astype(jnp.float32)
+                dk_lo = dk_lo + (dk1 + dk2).astype(jnp.float32)
+                dv_lo = dv_lo + (dv1 + dv2).astype(jnp.float32)
+                dk_hi = dk_hi + dk3.astype(jnp.float32)
+                dv_hi = dv_hi + dv3.astype(jnp.float32)
+            else:
+                sel = hop <= my
+                dq2, dk2, dv2 = block_bwd(q_hi, k_lo, v_lo, g_hi, out_hi, lse_hi, d_hi, False)
+                dq_hi = dq_hi + dq2.astype(jnp.float32)
+                dk_lo = dk_lo + dk2.astype(jnp.float32)
+                dv_lo = dv_lo + dv2.astype(jnp.float32)
+                qb = jnp.where(sel, q_lo, q_hi)
+                kb = jnp.where(sel, k_lo, k_hi)
+                vb = jnp.where(sel, v_lo, v_hi)
+                gb = jnp.where(sel, g_lo, g_hi)
+                ob = jnp.where(sel, out_lo, out_hi)
+                lb = jnp.where(sel, lse_lo, lse_hi)
+                db = jnp.where(sel, d_lo, d_hi)
+                dqv, dkv_, dvv = block_bwd(qb, kb, vb, gb, ob, lb, db, False)
+                dqv = dqv.astype(jnp.float32)
+                dkv_ = dkv_.astype(jnp.float32)
+                dvv = dvv.astype(jnp.float32)
+                dq_lo = dq_lo + jnp.where(sel, dqv, 0)
+                dq_hi = dq_hi + jnp.where(sel, 0, dqv)
+                dk_lo = dk_lo + jnp.where(sel, dkv_, 0)
+                dk_hi = dk_hi + jnp.where(sel, 0, dkv_)
+                dv_lo = dv_lo + jnp.where(sel, dvv, 0)
+                dv_hi = dv_hi + jnp.where(sel, 0, dvv)
+            dkv_acc = jnp.stack([dk_lo, dk_hi, dv_lo, dv_hi])
+            # kv + its grad accumulators travel together; after R rotations
+            # total the accumulators arrive back home
+            if hop < R - 1:
+                kcur = jax.lax.ppermute(kcur, axis_name, perm)
+                vcur = jax.lax.ppermute(vcur, axis_name, perm)
+            dkv_acc = jax.lax.ppermute(dkv_acc, axis_name, perm)
+        dk_lo, dk_hi, dv_lo, dv_hi = dkv_acc
+        dq = jnp.concatenate([dq_lo, dq_hi], axis=1)
+        dk = jnp.concatenate([dk_lo, dk_hi], axis=1)
+        dv = jnp.concatenate([dv_lo, dv_hi], axis=1)
+        return dq.astype(qf.dtype), dk.astype(kf.dtype), dv.astype(vf.dtype)
+
+    core.defvjp(fwd_rule, bwd_rule)
+    return from_f(core(to_f(q), to_f(k), to_f(v)).astype(q.dtype))
+
+
+def _gathered_zigzag_cp_local(q, k, v, axis_name, scale):
+    """Balanced causal context parallelism with GATHERED KV (the fast
+    regime when per-device KV fits — S*h*d*2B, e.g. 16MB at 32k/8h/128d):
+    q is zig-zag-sharded (device i holds chunks i and 2R-1-i, so causal
+    work is balanced) while K/V stay CONTIGUOUS-sharded — a tiled
+    all_gather of contiguous shards is already in global order, so the KV
+    side needs no permutes at all.  One fused offset-causal Pallas kernel
+    per direction (per-q-block absolute starts); dk/dv come back via a
+    single reduce-scatter straight onto the contiguous shards.  The
+    rotating-ring path (_ring_attention_zigzag_local) remains for KV that
+    cannot fit."""
+    from ....ops import flash_attention as fa
+
+    R = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, two_c, h, d = q.shape
+    c = two_c // 2
+    S = 2 * c * R
+    interp = fa._FORCE_INTERPRET
+
+    def to_f(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, -1, d)
+
+    def from_f(x):
+        return jnp.transpose(x.reshape(b, h, -1, d), (0, 2, 1, 3))
+
+    # q halves live at different global offsets: the single fused kernel
+    # call takes PER-Q-BLOCK absolute starts (streaming the gathered KV
+    # once per call — the per-call KV stream, not launches, is the fixed
+    # cost at these shapes)
+    bq = fa._pick_block(c, 1024)
+    off_lo = my * c
+    off_hi = (2 * R - 1 - my) * c
+    starts = fa.q_block_starts([(off_lo, c), (off_hi, c)], bq)
+
+    def gather(xf):
+        # contiguous shards -> tiled all_gather IS the global order
+        return jax.lax.all_gather(xf, axis_name, axis=1, tiled=True)  # [bh, S, d]
+
+    def _fwd(qf, kf, vf):
+        kg = gather(kf)
+        vg = gather(vf)
+        out, lse = fa._pallas_flash_forward(
+            qf, kg, vg, True, scale, interpret=interp, q_offset=starts,
+            block_q=bq,
+        )
+        return out, lse
+
+    @jax.custom_vjp
+    def core(qf, kf, vf):
+        return _fwd(qf, kf, vf)[0]
+
+    def fwd_rule(qf, kf, vf):
+        out, lse = _fwd(qf, kf, vf)
+        # kg/vg are regathered in bwd — residualizing them would pin
+        # O(S) per-device buffers across the whole model backward
+        return out, (qf, kf, vf, out, lse)
+
+    def bwd_rule(res, g):
+        qf, kf, vf, out, lse = res
+        kg = gather(kf)
+        vg = gather(vf)
+        dq, dk_full, dv_full = fa._pallas_flash_backward(
+            qf, kg, vg, g, out, lse, True, scale,
+            interpret=interp, q_offset=starts, block_q=bq,
+        )
+        # contiguous layout: the reduce-scatter lands each device's slab
+        dk = jax.lax.psum_scatter(dk_full, axis_name, scatter_dimension=1, tiled=True)
+        dv = jax.lax.psum_scatter(dv_full, axis_name, scatter_dimension=1, tiled=True)
+        return dq.astype(qf.dtype), dk.astype(kf.dtype), dv.astype(vf.dtype)
+
+    core.defvjp(fwd_rule, bwd_rule)
+    return from_f(core(to_f(q), to_f(k), to_f(v)))
+
+
+def _zigzag_perm(S, R):
+    """Chunk permutation: contiguous layout -> zig-zag (device i gets
+    chunks i and 2R-1-i) and its inverse, as index arrays over axis 1."""
+    c = S // (2 * R)
+    order = []
+    for i in range(R):
+        order += [i, 2 * R - 1 - i]
+    fwd = np.concatenate([np.arange(c) + ch * c for ch in order])
+    inv = np.empty_like(fwd)
+    inv[fwd] = np.arange(S)
+    return fwd, inv
+
+
 def _pallas_hops_viable(q, mesh, axis_name):
     from ....ops import flash_attention as fa
 
@@ -212,12 +479,52 @@ def ring_attention_array(q, k, v, axis_name="sep", causal=True, scale=None, mesh
         return sdpa_array(q, k, v, None, causal, scale)
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    spec = P(None, axis_name, None, None)
+    R = mesh.shape[axis_name]
+    S = q.shape[1]
+    c = S // (2 * R)
+    if (
+        causal
+        and _pallas_hops_viable(q, mesh, axis_name)
+        and S % (2 * R) == 0
+        and c % 128 == 0
+    ):
+        # balanced causal CP: zig-zag chunk layout (device i holds chunks
+        # i and 2R-1-i) — wall time is the balanced per-device cost, not
+        # the last device's full row.  One global chunk permute in, one out.
+        # KV that fits per-device (<= ~256MB) takes the gathered-KV form
+        # (2 rectangular offset-causal kernels/device); larger KV rotates
+        # hop-by-hop around the ring.
+        fwd_idx, inv_idx = _zigzag_perm(S, R)
+        kv_bytes = S * q.shape[2] * q.shape[3] * 2 * np.dtype(q.dtype).itemsize
+        if kv_bytes <= _GATHERED_KV_MAX_BYTES:
+            # only q (and the output) need the zig-zag layout — K/V stay
+            # contiguous-sharded and never pay a global permute
+            local = functools.partial(
+                _gathered_zigzag_cp_local, axis_name=axis_name, scale=scale
+            )
+            fn = jax.shard_map(
+                local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                check_vma=False,
+            )
+            qz = jnp.take(q, fwd_idx, axis=1)
+            return jnp.take(fn(qz, k, v), inv_idx, axis=1)
+        local = functools.partial(
+            _ring_attention_zigzag_local, axis_name=axis_name, scale=scale
+        )
+        fn = jax.shard_map(
+            local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+        qz = jnp.take(q, fwd_idx, axis=1)
+        kz = jnp.take(k, fwd_idx, axis=1)
+        vz = jnp.take(v, fwd_idx, axis=1)
+        return jnp.take(fn(qz, kz, vz), inv_idx, axis=1)
     local = (
         _ring_attention_pallas_local
         if _pallas_hops_viable(q, mesh, axis_name)
         else _ring_attention_local
     )
-    spec = P(None, axis_name, None, None)
     fn = jax.shard_map(
         functools.partial(local, axis_name=axis_name, causal=causal, scale=scale),
         mesh=mesh,

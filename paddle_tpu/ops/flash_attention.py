@@ -49,7 +49,8 @@ def _on_tpu():
 
 def _blk_mask(s, q_start, k_start, block_q, block_k, causal, sq=None, sk=None):
     """Apply causal and/or segment masking to a [block_q, block_k] score
-    block.  sq/sk: per-row/col segment ids (or None)."""
+    block.  sq/sk: per-row/col segment ids (or None).  q_start may carry a
+    global offset (context-parallel rectangular causal blocks)."""
     masked = s
     if causal:
         q_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
@@ -63,6 +64,7 @@ def _blk_mask(s, q_start, k_start, block_q, block_k, causal, sq=None, sk=None):
 def _flash_fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     *, causal, scale, block_q, block_k, seg_refs=(), carry_refs=(),
+    off_ref=None,
 ):
     """Grid (bh blocks, q blocks, k blocks), k innermost: one K/V tile per
     step, (m, l, acc) carried in VMEM scratch across the sequential grid.
@@ -74,7 +76,13 @@ def _flash_fwd_kernel(
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     n_k = pl.num_programs(2)
-    q_start = qi * block_q
+    if off_ref is not None:
+        # per-q-block ABSOLUTE start positions (context-parallel
+        # rectangular causal blocks; zig-zag q halves have different
+        # global offsets, so each block carries its own)
+        q_start = off_ref[qi]
+    else:
+        q_start = qi * block_q
     k_start = ki * block_k
 
     @pl.when(ki == 0)
@@ -127,6 +135,19 @@ def _flash_fwd_kernel(
         lse_ref[...] = (m_scr[..., 0] + jnp.log(l_safe))[..., None]
 
 
+def q_block_starts(offsets_and_lens, bq):
+    """Per-q-block absolute start positions for a q tensor formed by
+    concatenating chunks: [(global_offset, rows), ...] -> int32 array.
+    `bq` must divide every chunk's row count (blocks may not straddle
+    chunks — rows within a block share one contiguous global range)."""
+    starts = []
+    for off, n in offsets_and_lens:
+        assert n % bq == 0, (n, bq)
+        for r in range(0, n, bq):
+            starts.append(off + r)
+    return jnp.stack([jnp.asarray(o, jnp.int32) for o in starts])
+
+
 def _pick_block(seq_len, pref):
     """Largest multiple-of-128 divisor of seq_len that is <= pref: big
     blocks amortize the per-grid-step q reload (seq 384 must pick 384, not
@@ -158,11 +179,15 @@ def _pick_bh_block(bh, n_heads, block_q, block_k, d, has_segments):
 
 def _pallas_flash_forward(q, k, v, causal, scale, segments=None, n_heads=1,
                           block_q=1024, block_k=1024, interpret=False,
-                          carry=None, out_dtype=None):
+                          carry=None, out_dtype=None, q_offset=None):
     """q,k,v: [bh, seq, d]; segments: optional [b, seq, 1] int32 (shared
     across the head dim via the index map); carry: optional
     (out_prev [bh, seq, d], lse_prev [bh, seq, 1]) continuation state —
-    this call merges its blocks ONTO the carry (ring-attention hops).
+    this call merges its blocks ONTO the carry (ring-attention hops);
+    q_offset: optional int32 [seq/block_q] (may be traced) — ABSOLUTE
+    global start position of each q block, for rectangular causal blocks
+    whose rows are not contiguous in global positions (zig-zag context
+    parallelism); build with q_block_starts().
     Returns (out [bh, seq, d], lse [bh, seq, 1] f32)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -177,26 +202,31 @@ def _pallas_flash_forward(q, k, v, causal, scale, segments=None, n_heads=1,
     grid = (bh // bb, seq_len // block_q, k_len // block_k)
 
     in_specs = [
-        pl.BlockSpec((bb, block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((bb, block_k, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((bb, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((bb, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+        pl.BlockSpec((bb, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+        pl.BlockSpec((bb, block_k, d), lambda b, i, j, *_: (b, j, 0)),
     ]
     args = [q, k, v]
     if segments is not None:
         # bb divides n_heads, so one bh block maps to exactly one batch row
         in_specs += [
-            pl.BlockSpec((None, block_q, 1), lambda b, i, j: ((b * bb) // n_heads, i, 0)),
-            pl.BlockSpec((None, block_k, 1), lambda b, i, j: ((b * bb) // n_heads, j, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j, *_: ((b * bb) // n_heads, i, 0)),
+            pl.BlockSpec((None, block_k, 1), lambda b, i, j, *_: ((b * bb) // n_heads, j, 0)),
         ]
         args += [segments, segments]
     if carry is not None:
         in_specs += [
-            pl.BlockSpec((bb, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((bb, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((bb, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+            pl.BlockSpec((bb, block_q, 1), lambda b, i, j, *_: (b, i, 0)),
         ]
         args += [carry[0], carry[1]]
 
-    def kernel(q_ref, k_ref, v_ref, *rest):
+    def kernel(*refs):
+        if q_offset is not None:
+            off_ref, refs = refs[0], refs[1:]
+        else:
+            off_ref = None
+        q_ref, k_ref, v_ref, *rest = refs
         if segments is not None:
             seg_refs, rest = rest[:2], rest[2:]
         else:
@@ -209,27 +239,38 @@ def _pallas_flash_forward(q, k, v, causal, scale, segments=None, n_heads=1,
         _flash_fwd_kernel(
             q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             causal=causal, scale=scale, block_q=block_q, block_k=block_k,
-            seg_refs=seg_refs, carry_refs=carry_refs,
+            seg_refs=seg_refs, carry_refs=carry_refs, off_ref=off_ref,
         )
 
+    out_specs = [
+        pl.BlockSpec((bb, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+        # [bh, seq, 1] — a trailing unit dim keeps the block TPU-tileable
+        pl.BlockSpec((bb, block_q, 1), lambda b, i, j, *_: (b, i, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct(q.shape, out_dtype or q.dtype),
+        jax.ShapeDtypeStruct((bh, seq_len, 1), jnp.float32),
+    ]
+    scratch = [
+        pltpu.VMEM((bb, block_q, 1), jnp.float32),
+        pltpu.VMEM((bb, block_q, 1), jnp.float32),
+        pltpu.VMEM((bb, block_q, d), jnp.float32),
+    ]
+    if q_offset is not None:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1, grid=grid, in_specs=in_specs,
+            out_specs=out_specs, scratch_shapes=scratch,
+        )
+        return pl.pallas_call(
+            kernel, grid_spec=grid_spec, out_shape=out_shape, interpret=interpret,
+        )(jnp.asarray(q_offset, jnp.int32).reshape(-1), *args)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((bb, block_q, d), lambda b, i, j: (b, i, 0)),
-            # [bh, seq, 1] — a trailing unit dim keeps the block TPU-tileable
-            pl.BlockSpec((bb, block_q, 1), lambda b, i, j: (b, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct(q.shape, out_dtype or q.dtype),
-            jax.ShapeDtypeStruct((bh, seq_len, 1), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((bb, block_q, 1), jnp.float32),
-            pltpu.VMEM((bb, block_q, 1), jnp.float32),
-            pltpu.VMEM((bb, block_q, d), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
         interpret=interpret,
     )(*args)
 
@@ -242,6 +283,7 @@ def _pallas_flash_forward(q, k, v, causal, scale, segments=None, n_heads=1,
 def _flash_bwd_dkdv_kernel(
     q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_scr, dv_scr, *, causal, scale, block_q, block_k, seg_refs=(),
+    off_ref=None,
 ):
     """Grid (bh, k blocks, q blocks), q innermost; dk/dv accumulate in
     scratch across the q sweep."""
@@ -251,7 +293,7 @@ def _flash_bwd_dkdv_kernel(
     qi = pl.program_id(2)
     n_q = pl.num_programs(2)
     k_start = ki * block_k
-    q_start = qi * block_q
+    q_start = off_ref[qi] if off_ref is not None else qi * block_q
 
     @pl.when(qi == 0)
     def _init():
@@ -297,7 +339,7 @@ def _flash_bwd_dkdv_kernel(
 
 def _flash_bwd_dq_kernel(
     q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-    *, causal, scale, block_q, block_k, seg_refs=(),
+    *, causal, scale, block_q, block_k, seg_refs=(), off_ref=None,
 ):
     """Grid (bh, q blocks, k blocks), k innermost; dq accumulates in
     scratch across the k sweep."""
@@ -306,7 +348,7 @@ def _flash_bwd_dq_kernel(
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     n_k = pl.num_programs(2)
-    q_start = qi * block_q
+    q_start = off_ref[qi] if off_ref is not None else qi * block_q
     k_start = ki * block_k
 
     @pl.when(ki == 0)
@@ -347,9 +389,10 @@ def _flash_bwd_dq_kernel(
 
 def _pallas_flash_backward(q, k, v, g, out, lse, causal, scale, segments=None,
                            n_heads=1, block_q=1024, block_k=1024, interpret=False,
-                           delta=None):
+                           delta=None, q_offset=None):
     """q/g/out/lse: [bh, sq, ...]; k/v: [bh, sk, d] — rectangular k is
-    allowed for the non-causal ring-hop case (causal assumes sq == sk).
+    allowed (causal with sq != sk requires q_offset: absolute per-q-block
+    start positions; without q_offset, causal assumes sq == sk).
     delta: optional precomputed rowsum(g*out) [bh, sq, 1] — the ring path
     computes it ONCE for all hops instead of once per hop.
     Returns (dq, dk, dv)."""
@@ -370,82 +413,123 @@ def _pallas_flash_backward(q, k, v, g, out, lse, causal, scale, segments=None,
 
     # -- dk/dv: grid over k blocks, stream q --------------------------------
     in_specs = [
-        pl.BlockSpec((bb, block_q, d), lambda b, i, j: (b, j, 0)),  # q
-        pl.BlockSpec((bb, block_k, d), lambda b, i, j: (b, i, 0)),  # k
-        pl.BlockSpec((bb, block_k, d), lambda b, i, j: (b, i, 0)),  # v
-        pl.BlockSpec((bb, block_q, d), lambda b, i, j: (b, j, 0)),  # g
-        pl.BlockSpec((bb, block_q, 1), lambda b, i, j: (b, j, 0)),  # lse
-        pl.BlockSpec((bb, block_q, 1), lambda b, i, j: (b, j, 0)),  # delta
+        pl.BlockSpec((bb, block_q, d), lambda b, i, j, *_: (b, j, 0)),  # q
+        pl.BlockSpec((bb, block_k, d), lambda b, i, j, *_: (b, i, 0)),  # k
+        pl.BlockSpec((bb, block_k, d), lambda b, i, j, *_: (b, i, 0)),  # v
+        pl.BlockSpec((bb, block_q, d), lambda b, i, j, *_: (b, j, 0)),  # g
+        pl.BlockSpec((bb, block_q, 1), lambda b, i, j, *_: (b, j, 0)),  # lse
+        pl.BlockSpec((bb, block_q, 1), lambda b, i, j, *_: (b, j, 0)),  # delta
     ]
     args = [q, k, v, g, lse, delta]
     if segments is not None:
         in_specs += [
-            pl.BlockSpec((None, block_q, 1), lambda b, i, j: ((b * bb) // n_heads, j, 0)),
-            pl.BlockSpec((None, block_k, 1), lambda b, i, j: ((b * bb) // n_heads, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j, *_: ((b * bb) // n_heads, j, 0)),
+            pl.BlockSpec((None, block_k, 1), lambda b, i, j, *_: ((b * bb) // n_heads, i, 0)),
         ]
         args += [segments, segments]
 
-    def dkdv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest):
+    def dkdv_kernel(*refs):
+        if q_offset is not None:
+            off_ref, refs = refs[0], refs[1:]
+        else:
+            off_ref = None
+        q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest = refs
         seg_refs = rest[:2] if segments is not None else ()
         dk_ref, dv_ref, dk_scr, dv_scr = rest[-4:]
         _flash_bwd_dkdv_kernel(
             q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-            dk_scr, dv_scr, seg_refs=seg_refs, **common,
+            dk_scr, dv_scr, seg_refs=seg_refs, off_ref=off_ref, **common,
         )
 
-    dk, dv = pl.pallas_call(
-        dkdv_kernel,
-        grid=(bh // bb, sk // block_k, s // block_q),
-        in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((bb, block_k, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((bb, block_k, d), lambda b, i, j: (b, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((bb, block_k, d), jnp.float32),
-            pltpu.VMEM((bb, block_k, d), jnp.float32),
-        ],
-        interpret=interpret,
-    )(*args)
+    dkdv_grid = (bh // bb, sk // block_k, s // block_q)
+    dkdv_out_specs = [
+        pl.BlockSpec((bb, block_k, d), lambda b, i, j, *_: (b, i, 0)),
+        pl.BlockSpec((bb, block_k, d), lambda b, i, j, *_: (b, i, 0)),
+    ]
+    dkdv_out_shape = [
+        jax.ShapeDtypeStruct(k.shape, k.dtype),
+        jax.ShapeDtypeStruct(v.shape, v.dtype),
+    ]
+    dkdv_scratch = [
+        pltpu.VMEM((bb, block_k, d), jnp.float32),
+        pltpu.VMEM((bb, block_k, d), jnp.float32),
+    ]
+    if q_offset is not None:
+        off_arr = jnp.asarray(q_offset, jnp.int32).reshape(-1)
+        dk, dv = pl.pallas_call(
+            dkdv_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1, grid=dkdv_grid, in_specs=in_specs,
+                out_specs=dkdv_out_specs, scratch_shapes=dkdv_scratch,
+            ),
+            out_shape=dkdv_out_shape,
+            interpret=interpret,
+        )(off_arr, *args)
+    else:
+        dk, dv = pl.pallas_call(
+            dkdv_kernel,
+            grid=dkdv_grid,
+            in_specs=in_specs,
+            out_specs=dkdv_out_specs,
+            out_shape=dkdv_out_shape,
+            scratch_shapes=dkdv_scratch,
+            interpret=interpret,
+        )(*args)
 
     # -- dq: grid over q blocks, stream k -----------------------------------
     in_specs = [
-        pl.BlockSpec((bb, block_q, d), lambda b, i, j: (b, i, 0)),  # q
-        pl.BlockSpec((bb, block_k, d), lambda b, i, j: (b, j, 0)),  # k
-        pl.BlockSpec((bb, block_k, d), lambda b, i, j: (b, j, 0)),  # v
-        pl.BlockSpec((bb, block_q, d), lambda b, i, j: (b, i, 0)),  # g
-        pl.BlockSpec((bb, block_q, 1), lambda b, i, j: (b, i, 0)),  # lse
-        pl.BlockSpec((bb, block_q, 1), lambda b, i, j: (b, i, 0)),  # delta
+        pl.BlockSpec((bb, block_q, d), lambda b, i, j, *_: (b, i, 0)),  # q
+        pl.BlockSpec((bb, block_k, d), lambda b, i, j, *_: (b, j, 0)),  # k
+        pl.BlockSpec((bb, block_k, d), lambda b, i, j, *_: (b, j, 0)),  # v
+        pl.BlockSpec((bb, block_q, d), lambda b, i, j, *_: (b, i, 0)),  # g
+        pl.BlockSpec((bb, block_q, 1), lambda b, i, j, *_: (b, i, 0)),  # lse
+        pl.BlockSpec((bb, block_q, 1), lambda b, i, j, *_: (b, i, 0)),  # delta
     ]
     args = [q, k, v, g, lse, delta]
     if segments is not None:
         in_specs += [
-            pl.BlockSpec((None, block_q, 1), lambda b, i, j: ((b * bb) // n_heads, i, 0)),
-            pl.BlockSpec((None, block_k, 1), lambda b, i, j: ((b * bb) // n_heads, j, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j, *_: ((b * bb) // n_heads, i, 0)),
+            pl.BlockSpec((None, block_k, 1), lambda b, i, j, *_: ((b * bb) // n_heads, j, 0)),
         ]
         args += [segments, segments]
 
-    def dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest):
+    def dq_kernel(*refs):
+        if q_offset is not None:
+            off_ref, refs = refs[0], refs[1:]
+        else:
+            off_ref = None
+        q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest = refs
         seg_refs = rest[:2] if segments is not None else ()
         dq_ref, dq_scr = rest[-2:]
         _flash_bwd_dq_kernel(
             q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-            seg_refs=seg_refs, **common,
+            seg_refs=seg_refs, off_ref=off_ref, **common,
         )
 
-    dq = pl.pallas_call(
-        dq_kernel,
-        grid=(bh // bb, s // block_q, sk // block_k),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((bb, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        scratch_shapes=[pltpu.VMEM((bb, block_q, d), jnp.float32)],
-        interpret=interpret,
-    )(*args)
+    dq_grid = (bh // bb, s // block_q, sk // block_k)
+    dq_out_spec = pl.BlockSpec((bb, block_q, d), lambda b, i, j, *_: (b, i, 0))
+    dq_out_shape = jax.ShapeDtypeStruct(q.shape, q.dtype)
+    dq_scratch = [pltpu.VMEM((bb, block_q, d), jnp.float32)]
+    if q_offset is not None:
+        dq = pl.pallas_call(
+            dq_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1, grid=dq_grid, in_specs=in_specs,
+                out_specs=dq_out_spec, scratch_shapes=dq_scratch,
+            ),
+            out_shape=dq_out_shape,
+            interpret=interpret,
+        )(off_arr, *args)
+    else:
+        dq = pl.pallas_call(
+            dq_kernel,
+            grid=dq_grid,
+            in_specs=in_specs,
+            out_specs=dq_out_spec,
+            out_shape=dq_out_shape,
+            scratch_shapes=dq_scratch,
+            interpret=interpret,
+        )(*args)
     return dq, dk, dv
 
 

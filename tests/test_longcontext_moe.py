@@ -260,3 +260,43 @@ class TestRingPallasHops:
                     np.testing.assert_allclose(np.asarray(a), np.asarray(bb), atol=1e-4)
         finally:
             fa._FORCE_INTERPRET = saved
+
+    @pytest.mark.parametrize("mode", ["gathered", "rotating"])
+    def test_zigzag_causal_ring_matches_dense(self, mode):
+        # the balanced zig-zag layout (chunks (i, 2R-1-i) per device) must
+        # match dense causal attention exactly, fwd and grad — including
+        # the global chunk permute in/out and the traced half-selects
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.distributed.fleet.meta_parallel import ring_attention as ra
+        from paddle_tpu.ops import flash_attention as fa
+
+        saved = fa._FORCE_INTERPRET
+        saved_thresh = ra._GATHERED_KV_MAX_BYTES
+        fa._FORCE_INTERPRET = True
+        if mode == "rotating":
+            ra._GATHERED_KV_MAX_BYTES = 0  # force the hop-by-hop ring form
+        try:
+            pmesh.build_mesh(sep=4)
+            rng = np.random.RandomState(1)
+            b, S, h, d = 1, 2048, 2, 64  # c = S/(2R) = 256: zig-zag eligible
+            q = jnp.asarray(rng.randn(b, S, h, d), jnp.float32)
+            k = jnp.asarray(rng.randn(b, S, h, d), jnp.float32)
+            v = jnp.asarray(rng.randn(b, S, h, d), jnp.float32)
+
+            def ring_loss(q, k, v):
+                out = ra.ring_attention_array(q, k, v, "sep", True)
+                return (out.astype(jnp.float32) ** 2).sum(), out
+
+            def dense_loss(q, k, v):
+                out = fa.sdpa_array(q, k, v, None, True, None)
+                return (out.astype(jnp.float32) ** 2).sum(), out
+
+            (_, o1), g1 = jax.value_and_grad(ring_loss, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+            (_, o2), g2 = jax.value_and_grad(dense_loss, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+            np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=2e-4)
+            for a, bb in zip(g1, g2):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(bb), atol=2e-3)
+        finally:
+            fa._FORCE_INTERPRET = saved
+            ra._GATHERED_KV_MAX_BYTES = saved_thresh

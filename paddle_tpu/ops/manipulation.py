@@ -740,3 +740,128 @@ def shard_index(x, index_num, nshards, shard_id, ignore_value=-1, name=None):
         return jnp.where(inside, a - lo, ignore_value).astype(a.dtype)
 
     return apply(f, [x], name="shard_index")
+
+
+# -- round-5 long tail (reference python/paddle/tensor/manipulation.py) -----
+def hstack(x, name=None):
+    return apply(lambda *a: jnp.hstack(a), [coerce(t) for t in x], name="hstack")
+
+
+def vstack(x, name=None):
+    return apply(lambda *a: jnp.vstack(a), [coerce(t) for t in x], name="vstack")
+
+
+def dstack(x, name=None):
+    return apply(lambda *a: jnp.dstack(a), [coerce(t) for t in x], name="dstack")
+
+
+def column_stack(x, name=None):
+    return apply(lambda *a: jnp.column_stack(a), [coerce(t) for t in x], name="column_stack")
+
+
+def fliplr(x, name=None):
+    return apply(lambda a: jnp.fliplr(a), [coerce(x)], name="fliplr")
+
+
+def flipud(x, name=None):
+    return apply(lambda a: jnp.flipud(a), [coerce(x)], name="flipud")
+
+
+def ravel(x, name=None):
+    return apply(lambda a: a.ravel(), [coerce(x)], name="ravel")
+
+
+def msort(x, name=None):
+    return apply(lambda a: jnp.sort(a, axis=0), [coerce(x)], name="msort")
+
+
+def cartesian_prod(x, name=None):
+    """Cartesian product of 1-D tensors (reference: paddle.cartesian_prod)."""
+    ins = [coerce(t) for t in x]
+
+    def f(*arrs):
+        grids = jnp.meshgrid(*arrs, indexing="ij")
+        return jnp.stack([g.ravel() for g in grids], axis=-1)
+
+    return apply(f, ins, name="cartesian_prod")
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """r-length combinations of a 1-D tensor (reference:
+    paddle.combinations).  Index set is computed host-side (static shape)."""
+    import itertools
+
+    import numpy as _np
+
+    if r < 1:
+        raise ValueError(f"combinations: r must be >= 1, got {r}")
+    x = coerce(x)
+    n = x.shape[0]
+    it = (
+        itertools.combinations_with_replacement(range(n), r)
+        if with_replacement
+        else itertools.combinations(range(n), r)
+    )
+    idx = _np.array(list(it), _np.int32).reshape(-1, r)
+    return apply(lambda a: a[jnp.asarray(idx)], [x], name="combinations")
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    """Embed `value` into x at the sliced region (reference:
+    paddle.slice_scatter)."""
+    x, value = coerce(x), coerce(value)
+
+    import builtins
+
+    def f(a, v):
+        # NB: this module defines paddle.slice, shadowing the builtin
+        sl = [builtins.slice(None)] * a.ndim
+        for ax, st, en, sr in zip(axes, starts, ends, strides):
+            sl[ax] = builtins.slice(st, en, sr)
+        return a.at[tuple(sl)].set(v.astype(a.dtype))
+
+    return apply(f, [x, value], name="slice_scatter")
+
+
+def select_scatter(x, value, axis, index, name=None):
+    """Embed `value` at position `index` along `axis` (reference:
+    paddle.select_scatter)."""
+    x, value = coerce(x), coerce(value)
+
+    import builtins
+
+    def f(a, v):
+        sl = [builtins.slice(None)] * a.ndim
+        sl[axis] = index
+        return a.at[tuple(sl)].set(v.astype(a.dtype))
+
+    return apply(f, [x, value], name="select_scatter")
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Fill masked positions from `value`'s leading elements (reference:
+    paddle.masked_scatter).  `value` must supply at least mask.sum()
+    elements — checked eagerly (data-dependent, so unverifiable under
+    @to_static tracing, where an undersized value repeats its last
+    element)."""
+    import jax as _jax
+
+    x, mask, value = coerce(x), coerce(mask), coerce(value)
+    if not isinstance(mask._data, _jax.core.Tracer):
+        import numpy as _np
+
+        needed = int(_np.asarray(jnp.broadcast_to(mask._data, x._data.shape).sum()))
+        if value.size < needed:
+            raise ValueError(
+                f"masked_scatter: value has {value.size} elements but mask "
+                f"selects {needed}"
+            )
+
+    def f(a, m, v):
+        mb = jnp.broadcast_to(m, a.shape).astype(bool)
+        # k-th True position takes v.ravel()[k] (the reference contract)
+        order = jnp.cumsum(mb.ravel()) - 1
+        gathered = v.ravel()[jnp.clip(order, 0, v.size - 1)].reshape(a.shape)
+        return jnp.where(mb, gathered.astype(a.dtype), a)
+
+    return apply(f, [x, mask, value], name="masked_scatter")

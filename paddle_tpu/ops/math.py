@@ -455,3 +455,71 @@ def tensordot(x, y, axes=2, name=None):
     if isinstance(ax, (list, tuple)):
         ax = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in ax)
     return apply(lambda a, b: jnp.tensordot(a, b, axes=ax), [x, y], name="tensordot")
+
+
+# -- round-5 long tail (reference python/paddle/tensor/math.py) -------------
+i0e = unary_op("i0e", jax.scipy.special.i0e)
+i1e = unary_op("i1e", jax.scipy.special.i1e)
+gammaln = unary_op("gammaln", jax.scipy.special.gammaln)
+positive = unary_op("positive", lambda a: a)
+isneginf = unary_op("isneginf", jnp.isneginf)
+isposinf = unary_op("isposinf", jnp.isposinf)
+isreal = unary_op("isreal", jnp.isreal)
+
+
+def multigammaln(x, p, name=None):
+    """log multivariate gamma (reference: paddle.multigammaln)."""
+    x = coerce(x)
+    p = int(p)
+
+    def f(a):
+        a32 = a.astype(jnp.float32) if a.dtype not in (jnp.float32, jnp.float64) else a
+        out = 0.25 * p * (p - 1) * jnp.log(jnp.asarray(jnp.pi, a32.dtype))
+        for i in range(p):
+            out = out + jax.scipy.special.gammaln(a32 - 0.5 * i)
+        # preserve inexact input dtypes (bf16/f16 included); ints -> f32
+        if jnp.issubdtype(a.dtype, jnp.inexact):
+            return out.astype(a.dtype)
+        return out
+
+    return apply(f, [x], name="multigammaln")
+
+
+def frexp(x, name=None):
+    """Decompose into (mantissa, exponent) with 0.5 <= |m| < 1 (reference:
+    paddle.frexp)."""
+    x = coerce(x)
+
+    def f(a):
+        m, e = jnp.frexp(a)
+        return m, e.astype(jnp.int32)
+
+    return apply(f, [x], multi=True, name="frexp")
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    x, t = coerce(x), coerce(test_x)
+    return apply(
+        lambda a, b: jnp.isin(a, b, assume_unique=assume_unique, invert=invert),
+        [x, t],
+        name="isin",
+    )
+
+
+def vdot(x, y, name=None):
+    """Flattened conjugating dot product (reference: paddle.vdot)."""
+    x, y = coerce(x), coerce(y)
+    return apply(lambda a, b: jnp.vdot(a, b), [x, y], name="vdot")
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    """Fill in place with Cauchy samples (reference: Tensor.cauchy_)."""
+    from .random import _key
+
+    x = coerce(x)
+    key = _key()
+
+    def f(a):
+        return loc + scale * jax.random.cauchy(key, a.shape, jnp.float32).astype(a.dtype)
+
+    return inplace_rebind(x, apply(f, [x], name="cauchy_"))

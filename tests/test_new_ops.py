@@ -3,6 +3,7 @@ thresholded_relu / sequence_mask / conv1d_transpose / affine_grid /
 grid_sample; paddle label_smooth)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn.functional as F
@@ -348,3 +349,134 @@ def test_fused_mha_cache_and_2d_layout():
     o2 = incubate.nn.functional.fused_multi_head_attention(
         t(x), t(w2d), t(lin_w), transpose_qkv_wb=True, num_heads=h, **kw).numpy()
     np.testing.assert_allclose(o2, o4, rtol=1e-6)
+
+
+class TestRound5LongTail:
+    """Round-5 long-tail ops vs numpy/scipy semantics (reference:
+    python/paddle/tensor/{math,manipulation}.py)."""
+
+    def test_stacks_and_flips(self):
+        a = np.arange(6).reshape(2, 3).astype(np.float32)
+        b = a + 10
+        np.testing.assert_allclose(paddle.hstack([t(a), t(b)]).numpy(), np.hstack([a, b]))
+        np.testing.assert_allclose(paddle.vstack([t(a), t(b)]).numpy(), np.vstack([a, b]))
+        np.testing.assert_allclose(paddle.dstack([t(a), t(b)]).numpy(), np.dstack([a, b]))
+        np.testing.assert_allclose(
+            paddle.column_stack([t(a[:, 0]), t(b[:, 0])]).numpy(),
+            np.column_stack([a[:, 0], b[:, 0]]),
+        )
+        np.testing.assert_allclose(paddle.fliplr(t(a)).numpy(), np.fliplr(a))
+        np.testing.assert_allclose(paddle.flipud(t(a)).numpy(), np.flipud(a))
+        np.testing.assert_allclose(paddle.ravel(t(a)).numpy(), a.ravel())
+        np.testing.assert_allclose(
+            paddle.msort(t(np.array([[3.0, 1.0], [2.0, 4.0]], np.float32))).numpy(),
+            np.sort(np.array([[3.0, 1.0], [2.0, 4.0]], np.float32), axis=0),
+        )
+
+    def test_special_functions(self):
+        import scipy.special as sp
+
+        x = np.array([0.5, 1.5, 3.0], np.float32)
+        np.testing.assert_allclose(paddle.i0e(t(x)).numpy(), sp.i0e(x), rtol=1e-5)
+        np.testing.assert_allclose(paddle.i1e(t(x)).numpy(), sp.i1e(x), rtol=1e-5)
+        np.testing.assert_allclose(paddle.gammaln(t(x)).numpy(), sp.gammaln(x), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.multigammaln(t(np.array([3.0, 4.5], np.float32)), 2).numpy(),
+            sp.multigammaln(np.array([3.0, 4.5]), 2),
+            rtol=1e-5,
+        )
+
+    def test_predicates_and_misc(self):
+        x = np.array([1.0, -np.inf, np.inf, np.nan], np.float32)
+        np.testing.assert_array_equal(paddle.isneginf(t(x)).numpy(), np.isneginf(x))
+        np.testing.assert_array_equal(paddle.isposinf(t(x)).numpy(), np.isposinf(x))
+        np.testing.assert_array_equal(
+            paddle.isin(t(np.array([1, 2, 3, 4])), t(np.array([2, 4]))).numpy(),
+            np.isin([1, 2, 3, 4], [2, 4]),
+        )
+        np.testing.assert_allclose(paddle.positive(t(x[:1])).numpy(), x[:1])
+        a = np.array([1.0, 2.0], np.float32)
+        b = np.array([3.0, 4.0], np.float32)
+        np.testing.assert_allclose(paddle.vdot(t(a), t(b)).numpy(), np.vdot(a, b))
+        m, e = paddle.frexp(t(np.array([8.0, 0.75], np.float32)))
+        mm, ee = np.frexp(np.array([8.0, 0.75], np.float32))
+        np.testing.assert_allclose(m.numpy(), mm)
+        np.testing.assert_array_equal(e.numpy(), ee)
+
+    def test_combinatorics(self):
+        x = np.array([1.0, 2.0, 3.0], np.float32)
+        y = np.array([10.0, 20.0], np.float32)
+        out = paddle.cartesian_prod([t(x), t(y)]).numpy()
+        import itertools
+
+        ref = np.array(list(itertools.product(x, y)), np.float32)
+        np.testing.assert_allclose(out, ref)
+        comb = paddle.combinations(t(x), 2).numpy()
+        np.testing.assert_allclose(
+            comb, np.array(list(itertools.combinations(x, 2)), np.float32)
+        )
+
+    def test_scatter_family(self):
+        x = np.zeros((4, 4), np.float32)
+        v = np.ones((4, 2), np.float32)
+        out = paddle.slice_scatter(t(x), t(v), axes=[1], starts=[1], ends=[3], strides=[1]).numpy()
+        ref = x.copy()
+        ref[:, 1:3] = 1
+        np.testing.assert_allclose(out, ref)
+        out2 = paddle.select_scatter(t(x), t(np.full(4, 7.0, np.float32)), axis=0, index=2).numpy()
+        assert (out2[2] == 7).all() and out2[0].sum() == 0
+        xm = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+        mask = np.array([True, False, True, False])
+        vals = np.array([10.0, 20.0, 30.0], np.float32)
+        out3 = paddle.masked_scatter(t(xm), t(mask), t(vals)).numpy()
+        np.testing.assert_allclose(out3, [10.0, 2.0, 20.0, 4.0])
+
+    def test_cauchy_inplace(self):
+        paddle.seed(0)
+        x = t(np.zeros(2000, np.float32))
+        paddle.cauchy_(x, loc=1.0, scale=2.0)
+        s = x.numpy()
+        assert np.median(s) == pytest.approx(1.0, abs=0.3)  # Cauchy median = loc
+        assert (s != 0).all()
+
+    def test_masked_scatter_undersized_value_raises(self):
+        with pytest.raises(ValueError, match="masked_scatter"):
+            paddle.masked_scatter(
+                t(np.zeros(3, np.float32)),
+                t(np.array([True, True, True])),
+                t(np.array([1.0, 2.0], np.float32)),
+            )
+
+    def test_combinations_r0_raises(self):
+        with pytest.raises(ValueError, match="r must be"):
+            paddle.combinations(t(np.array([1.0, 2.0], np.float32)), 0)
+
+    def test_multigammaln_preserves_bf16(self):
+        out = paddle.multigammaln(
+            t(np.array([3.0], np.float32)).astype("bfloat16"), 2
+        )
+        assert "bfloat16" in str(out.dtype)
+
+    def test_generate_top_k_is_exact(self):
+        # the public generate(top_k=k) contract: sampled tokens must lie in
+        # the TRUE top-k of the model's logits (guards against approximate
+        # top-k creeping back in)
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(21)
+        model = LlamaForCausalLM(LlamaConfig.tiny())
+        rng = np.random.RandomState(21)
+        x = paddle.to_tensor(rng.randint(0, 256, (1, 6)).astype(np.int32))
+        k = 4
+        out = paddle.to_tensor(
+            model.generate(x, max_new_tokens=5, temperature=1.2, top_k=k, seed=9)
+            .numpy()
+            .astype(np.int32)
+        )
+        full = model(paddle.to_tensor(out.numpy()[:, :-1].astype(np.int32))).numpy()
+        toks = out.numpy()[0]
+        for step in range(5):
+            pos = 5 + step  # logits position predicting token pos+1
+            logits = full[0, pos]
+            topk_ids = np.argsort(logits)[-k:]
+            assert toks[pos + 1] in topk_ids, (step, toks[pos + 1])

@@ -1938,3 +1938,160 @@ def conv3d_transpose(
         return out
 
     return apply(f, ins, name="conv3d_transpose")
+
+
+# ---------------------------------------------------------------------------
+# round-5 long tail (reference python/paddle/nn/functional/)
+# ---------------------------------------------------------------------------
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    """NCL adaptive max pool (reference: F.adaptive_max_pool1d)."""
+    if return_mask:
+        raise NotImplementedError("adaptive_max_pool1d: return_mask unsupported")
+    x = coerce(x)
+    o = int(output_size) if not isinstance(output_size, (list, tuple)) else int(output_size[0])
+
+    def f(a):
+        n, c, l = a.shape
+        if l % o == 0:
+            return a.reshape(n, c, o, l // o).max(-1)
+        segs = [a[:, :, (i * l) // o : ((i + 1) * l + o - 1) // o].max(2, keepdims=True) for i in range(o)]
+        return jnp.concatenate(segs, 2)
+
+    return apply(f, [x], name="adaptive_max_pool1d")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    """Shuffle channels across groups (reference: F.channel_shuffle)."""
+    x = coerce(x)
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            return a.reshape(n, groups, c // groups, h, w).swapaxes(1, 2).reshape(a.shape)
+        n, h, w, c = a.shape
+        return a.reshape(n, h, w, groups, c // groups).swapaxes(3, 4).reshape(a.shape)
+
+    return apply(f, [x], name="channel_shuffle")
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0, data_format="NCL", output_size=None, name=None):
+    """Inverse of max_pool1d via the pooling indices (reference:
+    F.max_unpool1d)."""
+    x, indices = coerce(x), coerce(indices)
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    st = k if stride is None else (stride if isinstance(stride, int) else stride[0])
+    out_l = (
+        int(output_size[-1]) if output_size is not None
+        else (x.shape[-1] - 1) * st + k - 2 * padding
+    )
+
+    def f(a, idx):
+        n, c, l = a.shape
+        flat = jnp.zeros((n, c, out_l), a.dtype)
+        return flat.at[
+            jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None], idx
+        ].set(a)
+
+    return apply(f, [x, indices], name="max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, data_format="NCHW", output_size=None, name=None):
+    """Inverse of max_pool2d via flattened HW indices (reference:
+    F.max_unpool2d)."""
+    x, indices = coerce(x), coerce(indices)
+    kh, kw = _tuplize(kernel_size, 2)
+    sh, sw = (kh, kw) if stride is None else _tuplize(stride, 2)
+    ph, pw = _tuplize(padding, 2)
+    if output_size is not None:
+        oh, ow = int(output_size[-2]), int(output_size[-1])
+    else:
+        oh = (x.shape[-2] - 1) * sh + kh - 2 * ph
+        ow = (x.shape[-1] - 1) * sw + kw - 2 * pw
+
+    def f(a, idx):
+        n, c, h, w = a.shape
+        flat = jnp.zeros((n, c, oh * ow), a.dtype)
+        flat = flat.at[
+            jnp.arange(n)[:, None, None],
+            jnp.arange(c)[None, :, None],
+            idx.reshape(n, c, h * w),
+        ].set(a.reshape(n, c, h * w))
+        return flat.reshape(n, c, oh, ow)
+
+    return apply(f, [x, indices], name="max_unpool2d")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """log(1 + exp(-label * input)) (reference: F.soft_margin_loss)."""
+    input, label = coerce(input), coerce(label)
+    v = apply(
+        lambda a, y: jnp.log1p(jnp.exp(-y.astype(a.dtype) * a)),
+        [input, label], name="soft_margin_loss",
+    )
+    return _reduce(v, reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):
+    """Per-class BCE-with-logits averaged over classes (reference:
+    F.multi_label_soft_margin_loss)."""
+    input, label = coerce(input), coerce(label)
+    ins = [input, label] + ([coerce(weight)] if weight is not None else [])
+
+    def f(a, y, *w):
+        y = y.astype(a.dtype)
+        per = y * jax.nn.log_sigmoid(a) + (1 - y) * jax.nn.log_sigmoid(-a)
+        if w:
+            per = per * w[0]
+        return -per.mean(-1)
+
+    return _reduce(apply(f, ins, name="multi_label_soft_margin_loss"), reduction)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8, reduction="mean", name=None):
+    """Poisson NLL (reference: F.poisson_nll_loss)."""
+    input, label = coerce(input), coerce(label)
+
+    def f(a, y):
+        y = y.astype(a.dtype)
+        if log_input:
+            v = jnp.exp(a) - y * a
+        else:
+            v = a - y * jnp.log(a + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(2 * jnp.pi * (y + epsilon))
+            v = v + jnp.where(y > 1, stirling, 0.0)
+        return v
+
+    return _reduce(apply(f, [input, label], name="poisson_nll_loss"), reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6, reduction="mean", name=None):
+    """Gaussian NLL with predicted variance (reference: F.gaussian_nll_loss)."""
+    input, label, variance = coerce(input), coerce(label), coerce(variance)
+
+    def f(mu, y, var):
+        var = jnp.clip(var, epsilon, None)
+        v = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            v = v + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, v.dtype))
+        return v
+
+    return _reduce(apply(f, [input, label, variance], name="gaussian_nll_loss"), reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative, distance_function=None, margin=1.0, swap=False, reduction="mean", name=None):
+    """Triplet loss with a custom distance callable (reference:
+    F.triplet_margin_with_distance_loss)."""
+    from ... import ops as _ops
+
+    if distance_function is None:
+        distance_function = lambda a, b: pairwise_distance(a, b)  # noqa: E731
+    d_pos = distance_function(coerce(input), coerce(positive))
+    d_neg = distance_function(coerce(input), coerce(negative))
+    if swap:
+        d_pn = distance_function(coerce(positive), coerce(negative))
+        d_neg = _ops.minimum(d_neg, d_pn)
+    v = _ops.clip(d_pos - d_neg + margin, min=0.0)
+    return _reduce(v, reduction)

@@ -3,6 +3,7 @@ lapack/cublas kernels; here XLA's native linalg lowerings)."""
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..tensor import Tensor
@@ -194,3 +195,127 @@ def householder_product(x, tau, name=None):
 def einsum(equation, *operands):
     ops_ = [coerce(o) for o in operands]
     return apply(lambda *arrs: jnp.einsum(equation, *arrs), ops_, name="einsum")
+
+
+# -- round-5 long tail (reference python/paddle/tensor/linalg.py) -----------
+def cholesky_solve(x, y, upper=False, name=None):
+    """Solve A X = B given the Cholesky factor `y` of A (reference:
+    paddle.linalg.cholesky_solve)."""
+    x, y = coerce(x), coerce(y)
+
+    def f(b, L):
+        import jax.scipy.linalg as jsl
+
+        return jsl.cho_solve((L, not upper), b)
+
+    return apply(f, [x, y], name="cholesky_solve")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    """LU factorization (reference: paddle.linalg.lu): returns (LU packed,
+    pivots 1-indexed[, info])."""
+    x = coerce(x)
+
+    def f(a):
+        import jax.scipy.linalg as jsl
+
+        lu_, piv = jsl.lu_factor(a)
+        return lu_, (piv + 1).astype(jnp.int32)
+
+    lu_, piv = apply(f, [x], multi=True, name="lu")
+    if get_infos:
+        from .creation import zeros
+
+        return lu_, piv, zeros([1], dtype="int32")
+    return lu_, piv
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack paddle.linalg.lu output into (P, L, U)."""
+    x, y = coerce(x), coerce(y)
+
+    def f(lu_, piv):
+        m, n = lu_.shape[-2], lu_.shape[-1]
+        k = min(m, n)
+        # paddle shapes: P (m, m), L (m, k), U (k, n)
+        L = jnp.tril(lu_[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_.dtype)
+        U = jnp.triu(lu_[..., :k, :])
+
+        def perm_one(pv):
+            perm = jnp.arange(m)
+            for i in range(pv.shape[-1]):
+                j = pv[i] - 1
+                pi, pj = perm[i], perm[j]
+                perm = perm.at[i].set(pj).at[j].set(pi)
+            return perm
+
+        if piv.ndim == 1:
+            P = jnp.eye(m, dtype=lu_.dtype)[perm_one(piv)].T
+        else:
+            pflat = piv.reshape(-1, piv.shape[-1])
+            perms = jax.vmap(perm_one)(pflat)
+            P = (
+                jnp.eye(m, dtype=lu_.dtype)[perms]
+                .swapaxes(-1, -2)
+                .reshape(piv.shape[:-1] + (m, m))
+            )
+        return P, L, U
+
+    return apply(f, [x, y], multi=True, name="lu_unpack")
+
+
+def matrix_exp(x, name=None):
+    x = coerce(x)
+
+    def f(a):
+        import jax.scipy.linalg as jsl
+
+        return jsl.expm(a)
+
+    return apply(f, [x], name="matrix_exp")
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Multiply y by the orthogonal Q from a QR given (householder vectors,
+    tau) (reference: paddle.linalg.ormqr)."""
+    x, tau, y = coerce(x), coerce(tau), coerce(y)
+
+    def f(a, t, other):
+        m = a.shape[-2]
+        # build the FULL m x m Q (LAPACK ormqr semantics): pad the reflector
+        # panel to square with zero columns and tau with zeros (identity
+        # reflectors)
+        pad_cols = m - a.shape[-1]
+        if pad_cols > 0:
+            a = jnp.concatenate([a, jnp.zeros(a.shape[:-1] + (pad_cols,), a.dtype)], -1)
+            t = jnp.concatenate([t, jnp.zeros(t.shape[:-1] + (pad_cols,), t.dtype)], -1)
+        Q = jax.lax.linalg.householder_product(a, t)
+        Qm = jnp.swapaxes(Q, -1, -2) if transpose else Q
+        return Qm @ other if left else other @ Qm
+
+    return apply(f, [x, tau, y], name="ormqr")
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference: paddle.linalg.svd_lowrank;
+    Halko et al. randomized range finder with `niter` power iterations)."""
+    from ..framework.random import default_generator
+
+    x = coerce(x)
+    key = default_generator.next_key()
+    ins = [x] + ([coerce(M)] if M is not None else [])
+
+    def f(a, *rest):
+        A = a - rest[0] if rest else a
+        m, n = A.shape[-2], A.shape[-1]
+        r = min(q, m, n)
+        G = jax.random.normal(key, A.shape[:-2] + (n, r), A.dtype)
+        Y = A @ G
+        for _ in range(niter):
+            Y = A @ (A.swapaxes(-1, -2) @ Y)
+        Q, _ = jnp.linalg.qr(Y)
+        B = Q.swapaxes(-1, -2) @ A
+        u, s, vh = jnp.linalg.svd(B, full_matrices=False)
+        return Q @ u, s, vh.swapaxes(-1, -2)
+
+    return apply(f, ins, multi=True, name="svd_lowrank")

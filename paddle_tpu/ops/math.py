@@ -523,3 +523,79 @@ def cauchy_(x, loc=0, scale=1, name=None):
         return loc + scale * jax.random.cauchy(key, a.shape, jnp.float32).astype(a.dtype)
 
     return inplace_rebind(x, apply(f, [x], name="cauchy_"))
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Trapezoidal integration (reference: paddle.trapezoid)."""
+    if x is not None and dx is not None:
+        raise ValueError("trapezoid: pass either x or dx, not both")
+    y = coerce(y)
+    ins = [y] + ([coerce(x)] if x is not None else [])
+    d = 1.0 if dx is None else dx
+
+    def f(a, *rest):
+        if rest:
+            return jnp.trapezoid(a, rest[0], axis=axis)
+        return jnp.trapezoid(a, dx=d, axis=axis)
+
+    return apply(f, ins, name="trapezoid")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Cumulative trapezoidal integration (reference:
+    paddle.cumulative_trapezoid)."""
+    if x is not None and dx is not None:
+        raise ValueError("cumulative_trapezoid: pass either x or dx, not both")
+    y = coerce(y)
+    ins = [y] + ([coerce(x)] if x is not None else [])
+    d = 1.0 if dx is None else dx
+
+    def f(a, *rest):
+        a = jnp.moveaxis(a, axis, -1)
+        if rest:
+            xs = rest[0]
+            if xs.ndim > 1:
+                xs = jnp.moveaxis(xs, axis, -1)
+            xs = jnp.broadcast_to(xs, a.shape)
+            widths = xs[..., 1:] - xs[..., :-1]
+        else:
+            widths = d
+        areas = (a[..., 1:] + a[..., :-1]) / 2.0 * widths
+        return jnp.moveaxis(jnp.cumsum(areas, -1), -1, axis)
+
+    return apply(f, ins, name="cumulative_trapezoid")
+
+
+def nanargmax(x, axis=None, keepdim=False, name=None):
+    x = coerce(x)
+    return apply(
+        lambda a: jnp.nanargmax(a, axis=axis, keepdims=keepdim),
+        [x], name="nanargmax",
+    )
+
+
+def nanargmin(x, axis=None, keepdim=False, name=None):
+    x = coerce(x)
+    return apply(
+        lambda a: jnp.nanargmin(a, axis=axis, keepdims=keepdim),
+        [x], name="nanargmin",
+    )
+
+
+def baddbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) batched (reference: paddle.baddbmm)."""
+    input, x, y = coerce(input), coerce(x), coerce(y)
+    return apply(
+        lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+        [input, x, y], name="baddbmm",
+    )
+
+
+def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
+    x = coerce(x)
+
+    def f(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        return jnp.linspace(lo, hi, bins + 1).astype(jnp.float32)
+
+    return apply(f, [x], name="histogram_bin_edges")

@@ -480,3 +480,174 @@ class TestRound5LongTail:
             logits = full[0, pos]
             topk_ids = np.argsort(logits)[-k:]
             assert toks[pos + 1] in topk_ids, (step, toks[pos + 1])
+
+
+class TestRound5LinalgAndLosses:
+    def test_cholesky_solve_and_lu(self):
+        rng = np.random.RandomState(0)
+        a = rng.rand(4, 4).astype(np.float32)
+        A = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        b = rng.rand(4, 2).astype(np.float32)
+        L = np.linalg.cholesky(A)
+        out = paddle.linalg.cholesky_solve(t(b), t(L)).numpy()
+        np.testing.assert_allclose(out, np.linalg.solve(A, b), rtol=1e-4, atol=1e-5)
+        lu_, piv = paddle.linalg.lu(t(A))
+        P, Lm, U = paddle.linalg.lu_unpack(lu_, piv)
+        np.testing.assert_allclose(
+            P.numpy() @ Lm.numpy() @ U.numpy(), A, rtol=1e-4, atol=1e-4
+        )
+
+    def test_matrix_exp_and_ormqr(self):
+        import scipy.linalg as sl
+        import torch
+
+        rng = np.random.RandomState(1)
+        m = rng.rand(3, 3).astype(np.float32) * 0.1
+        np.testing.assert_allclose(
+            paddle.linalg.matrix_exp(t(m)).numpy(), sl.expm(m), rtol=1e-4, atol=1e-5
+        )
+        # ormqr vs the torch oracle on the SAME geqrf reflectors
+        A = torch.tensor(rng.rand(4, 3).astype(np.float32))
+        h, tau = torch.geqrf(A)
+        y = torch.tensor(rng.rand(4, 2).astype(np.float32))
+        ref = torch.ormqr(h, tau, y).numpy()
+        out = paddle.linalg.ormqr(
+            t(h.numpy()), t(tau.numpy()), t(y.numpy())
+        ).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_svd_lowrank(self):
+        rng = np.random.RandomState(2)
+        # rank-3 matrix; q oversamples the rank (standard randomized-SVD
+        # practice) so the range capture is essentially exact
+        m = (rng.rand(8, 3) @ rng.rand(3, 6)).astype(np.float32)
+        paddle.seed(0)
+        U, S, V = paddle.linalg.svd_lowrank(t(m), q=5)
+        rec = U.numpy() @ np.diag(S.numpy()) @ V.numpy().T
+        # randomized method in f32: ~1e-2 relative is the practical floor
+        np.testing.assert_allclose(rec, m, rtol=2e-2, atol=2e-2)
+        np.testing.assert_allclose(
+            S.numpy()[:3], np.linalg.svd(m)[1][:3], rtol=2e-2
+        )
+
+    def test_trapezoid_family(self):
+        y = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+        np.testing.assert_allclose(paddle.trapezoid(t(y), dx=0.5).numpy(), np.trapezoid(y, dx=0.5))
+        ct = paddle.cumulative_trapezoid(t(y), dx=0.5).numpy()
+        ref = np.cumsum((y[1:] + y[:-1]) / 2 * 0.5)
+        np.testing.assert_allclose(ct, ref)
+
+    def test_nan_arg_and_baddbmm(self):
+        x = np.array([[1.0, np.nan, 3.0]], np.float32)
+        assert paddle.nanargmax(t(x), axis=1).numpy()[0] == 2
+        assert paddle.nanargmin(t(x), axis=1).numpy()[0] == 0
+        rng = np.random.RandomState(3)
+        i = rng.rand(2, 3, 4).astype(np.float32)
+        a = rng.rand(2, 3, 5).astype(np.float32)
+        b = rng.rand(2, 5, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.baddbmm(t(i), t(a), t(b), beta=0.5, alpha=2.0).numpy(),
+            0.5 * i + 2.0 * (a @ b), rtol=1e-5,
+        )
+
+    def test_new_losses_match_torch(self):
+        import torch
+        import torch.nn.functional as TF
+
+        rng = np.random.RandomState(4)
+        x = rng.randn(4, 5).astype(np.float32)
+        y01 = (rng.rand(4, 5) > 0.5).astype(np.float32)
+        ysign = np.where(rng.rand(4, 5) > 0.5, 1.0, -1.0).astype(np.float32)
+        var = (rng.rand(4, 5) + 0.5).astype(np.float32)
+        tgt = rng.randn(4, 5).astype(np.float32)
+
+        np.testing.assert_allclose(
+            F.soft_margin_loss(t(x), t(ysign)).numpy(),
+            TF.soft_margin_loss(torch.tensor(x), torch.tensor(ysign)).numpy(),
+            rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            F.multi_label_soft_margin_loss(t(x), t(y01)).numpy(),
+            TF.multilabel_soft_margin_loss(torch.tensor(x), torch.tensor(y01)).numpy(),
+            rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            F.poisson_nll_loss(t(x), t(np.abs(tgt))).numpy(),
+            TF.poisson_nll_loss(torch.tensor(x), torch.tensor(np.abs(tgt))).numpy(),
+            rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            F.gaussian_nll_loss(t(x), t(tgt), t(var)).numpy(),
+            TF.gaussian_nll_loss(torch.tensor(x), torch.tensor(tgt), torch.tensor(var)).numpy(),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_pool_and_shuffle_ops(self):
+        rng = np.random.RandomState(5)
+        x = rng.rand(2, 4, 12).astype(np.float32)
+        out = F.adaptive_max_pool1d(t(x), 3).numpy()
+        np.testing.assert_allclose(out, x.reshape(2, 4, 3, 4).max(-1))
+        x4 = rng.rand(1, 6, 2, 2).astype(np.float32)
+        cs = F.channel_shuffle(t(x4), 2).numpy()
+        ref = x4.reshape(1, 2, 3, 2, 2).swapaxes(1, 2).reshape(1, 6, 2, 2)
+        np.testing.assert_allclose(cs, ref)
+
+    def test_max_unpool_roundtrip(self):
+        import torch
+        import torch.nn.functional as TF
+
+        rng = np.random.RandomState(6)
+        x = rng.rand(1, 2, 8, 8).astype(np.float32)
+        tp, ti = TF.max_pool2d(torch.tensor(x), 2, return_indices=True)
+        ref = TF.max_unpool2d(tp, ti, 2).numpy()
+        out = F.max_unpool2d(
+            t(tp.numpy()), t(ti.numpy().astype(np.int64)), 2
+        ).numpy()
+        np.testing.assert_allclose(out, ref)
+
+    def test_triplet_with_distance(self):
+        rng = np.random.RandomState(7)
+        a, p, n = (rng.randn(4, 8).astype(np.float32) for _ in range(3))
+        import torch
+        import torch.nn.functional as TF
+
+        ref = TF.triplet_margin_with_distance_loss(
+            torch.tensor(a), torch.tensor(p), torch.tensor(n)
+        ).numpy()
+        out = F.triplet_margin_with_distance_loss(t(a), t(p), t(n)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+    def test_lu_batched_and_nonsquare(self):
+        rng = np.random.RandomState(8)
+        # batched square
+        A = rng.rand(2, 4, 4).astype(np.float32) + 4 * np.eye(4, dtype=np.float32)
+        lu_, piv = paddle.linalg.lu(t(A))
+        P, L, U = paddle.linalg.lu_unpack(lu_, piv)
+        np.testing.assert_allclose(
+            P.numpy() @ L.numpy() @ U.numpy(), A, rtol=1e-4, atol=1e-4
+        )
+        # tall non-square: paddle shapes P (m,m), L (m,k), U (k,n)
+        B = rng.rand(5, 3).astype(np.float32)
+        lu2, piv2 = paddle.linalg.lu(t(B))
+        P2, L2, U2 = paddle.linalg.lu_unpack(lu2, piv2)
+        assert list(P2.shape) == [5, 5] and list(L2.shape) == [5, 3] and list(U2.shape) == [3, 3]
+        np.testing.assert_allclose(
+            P2.numpy() @ L2.numpy() @ U2.numpy(), B, rtol=1e-4, atol=1e-5
+        )
+
+    def test_trapezoid_conflicting_args_raise(self):
+        y = t(np.ones(4, np.float32))
+        with pytest.raises(ValueError, match="not both"):
+            paddle.trapezoid(y, x=t(np.arange(4, dtype=np.float32)), dx=0.5)
+        with pytest.raises(ValueError, match="not both"):
+            paddle.cumulative_trapezoid(y, x=t(np.arange(4, dtype=np.float32)), dx=0.5)
+
+    def test_cumulative_trapezoid_nd_axis0(self):
+        import scipy.integrate as si
+
+        rng = np.random.RandomState(9)
+        y = rng.rand(3, 4).astype(np.float32)
+        xs = np.sort(rng.rand(3, 4), axis=0).astype(np.float32)
+        out = paddle.cumulative_trapezoid(t(y), x=t(xs), axis=0).numpy()
+        ref = si.cumulative_trapezoid(y, xs, axis=0)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)

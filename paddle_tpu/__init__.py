@@ -46,6 +46,10 @@ from . import incubate
 from . import sparse
 from . import fft
 from . import distribution
+from . import signal
+from . import regularizer
+from . import version  # noqa: F401
+from .version import full_version as __version__  # noqa: F401
 from . import static
 from . import inference
 from .framework.io import save, load  # noqa: F401
@@ -75,7 +79,6 @@ bool = "bool"
 complex64 = "complex64"
 complex128 = "complex128"
 
-__version__ = "0.1.0"
 
 
 def disable_static(place=None):

@@ -174,8 +174,28 @@ class Optimizer:
     def _update_param(self, p, g, lr):
         raise NotImplementedError
 
+    def _wd_terms(self):
+        """(coeff, is_l1) from a float-or-regularizer weight_decay."""
+        wd = self._weight_decay
+        if not wd:
+            return 0.0, False
+        from ..regularizer import L1Decay
+
+        return float(wd), isinstance(wd, L1Decay)
+
     def _apply_wd_l2(self, p_arr, g_arr, wd):
-        """classic L2 (reference 'weight_decay' regularize): g += wd * p."""
+        """Apply the regularizer to the gradient (reference 'weight_decay'
+        regularize): L2Decay / float -> g += wd * p; L1Decay ->
+        g += wd * sign(p)."""
+        from ..regularizer import L1Decay
+
+        if isinstance(wd, L1Decay):
+            if wd.coeff:
+                import jax.numpy as _jnp
+
+                return g_arr + wd.coeff * _jnp.sign(p_arr)
+            return g_arr
+        wd = float(wd) if wd else 0.0
         if wd:
             return g_arr + wd * p_arr
         return g_arr
@@ -297,7 +317,7 @@ class Momentum(Optimizer):
         self._nesterov = use_nesterov
 
     def _update_param(self, p, g, lr):
-        wd = self._weight_decay if isinstance(self._weight_decay, (int, float)) else 0.0
+        wd = self._weight_decay or 0.0  # float or regularizer object
         mu = self._momentum
         vel = self._acc("velocity", p)
         m = self._master(p)
@@ -331,7 +351,7 @@ class Adam(Optimizer):
 
     def _update_param(self, p, g, lr):
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
-        wd = self._weight_decay if isinstance(self._weight_decay, (int, float)) else 0.0
+        wd, wd_l1 = self._wd_terms()
         mom1 = self._acc("moment1", p)
         mom2 = self._acc("moment2", p)
         b1p = self._acc("beta1_pow", p, init=1.0)
@@ -344,7 +364,7 @@ class Adam(Optimizer):
             w32 = w.astype(jnp.float32)
             grad = grad.astype(jnp.float32)
             if wd and not decoupled:
-                grad = grad + wd * w32
+                grad = grad + wd * (jnp.sign(w32) if wd_l1 else w32)  # == _apply_wd_l2
             p1n = p1 * b1
             p2n = p2 * b2
             m_new = b1 * m + (1 - b1) * grad
@@ -353,7 +373,7 @@ class Adam(Optimizer):
             v_hat = v_new / (1 - p2n)
             upd = m_hat / (jnp.sqrt(v_hat) + eps)
             if wd and decoupled:
-                upd = upd + wd * w32
+                upd = upd + wd * (jnp.sign(w32) if wd_l1 else w32)
             return w32 - lr_ * upd, m_new, v_new, p1n, p2n
 
         new_w, m_new, v_new, p1n, p2n = apply(
@@ -491,9 +511,9 @@ class Lamb(Optimizer):
 
     def _update_param(self, p, g, lr):
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
-        wd = self._weight_decay or 0.0
+        wd_c, wd_l1 = self._wd_terms()
         if self._exclude_fn is not None and self._exclude_fn(p):
-            wd = 0.0
+            wd_c = 0.0
         m1 = self._acc("moment1", p)
         m2 = self._acc("moment2", p)
         b1p = self._acc("beta1_pow", p, init=1.0)
@@ -509,7 +529,9 @@ class Lamb(Optimizer):
             v_new = b2 * v + (1 - b2) * grad * grad
             m_hat = m_new / (1 - p1n)
             v_hat = v_new / (1 - p2n)
-            r = m_hat / (jnp.sqrt(v_hat) + eps) + wd * w32
+            r = m_hat / (jnp.sqrt(v_hat) + eps) + wd_c * (
+                jnp.sign(w32) if wd_l1 else w32
+            )
             w_norm = jnp.sqrt(jnp.sum(w32 * w32))
             r_norm = jnp.sqrt(jnp.sum(r * r))
             trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)

@@ -1,0 +1,108 @@
+"""paddle.signal — STFT family (reference: python/paddle/signal.py over
+phi frame/overlap_add + FFT kernels).  TPU-native: static-shape framing via
+gather + jnp.fft batched over frames (one XLA FFT op), inverse via
+overlap-add scatter with window-envelope normalization."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .ops.dispatch import apply, coerce
+
+__all__ = ["stft", "istft"]
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """Short-time Fourier transform (reference: paddle.signal.stft).
+
+    x: [..., seq_len] real (or complex with onesided=False).
+    Returns [..., n_fft//2+1 (or n_fft), n_frames] complex."""
+    import jax.numpy as jnp
+
+    x = coerce(x)
+    if "complex" in str(x.dtype) and onesided:
+        # the reference asserts the same: a complex signal has no Hermitian
+        # symmetry to exploit
+        raise ValueError("stft: onesided=True requires a real input; pass onesided=False")
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    ins = [x] + ([coerce(window)] if window is not None else [])
+
+    def f(a, *w):
+        if w:
+            win = w[0].astype(jnp.float32)
+            if win_length < n_fft:  # center-pad the window to n_fft
+                lp = (n_fft - win_length) // 2
+                win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        else:
+            win = jnp.ones((n_fft,), jnp.float32)
+        if center:
+            pad = [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            a = jnp.pad(a, pad, mode=pad_mode)
+        seq = a.shape[-1]
+        n_frames = 1 + (seq - n_fft) // hop_length
+        idx = (
+            jnp.arange(n_fft)[None, :]
+            + hop_length * jnp.arange(n_frames)[:, None]
+        )  # [frames, n_fft]
+        frames = a[..., idx] * win  # [..., frames, n_fft]
+        if onesided and not jnp.iscomplexobj(a):
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, frames]
+
+    return apply(f, ins, name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    """Inverse STFT via overlap-add (reference: paddle.signal.istft)."""
+    import jax.numpy as jnp
+
+    x = coerce(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    ins = [x] + ([coerce(window)] if window is not None else [])
+
+    def f(spec, *w):
+        if w:
+            win = w[0].astype(jnp.float32)
+            if win_length < n_fft:
+                lp = (n_fft - win_length) // 2
+                win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        else:
+            win = jnp.ones((n_fft,), jnp.float32)
+        spec = jnp.swapaxes(spec, -1, -2)  # [..., frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * win
+        n_frames = frames.shape[-2]
+        out_len = n_fft + hop_length * (n_frames - 1)
+        lead = frames.shape[:-2]
+        sig = jnp.zeros(lead + (out_len,), frames.dtype)
+        env = jnp.zeros((out_len,), jnp.float32)
+        idx = (
+            jnp.arange(n_fft)[None, :]
+            + hop_length * jnp.arange(n_frames)[:, None]
+        ).reshape(-1)
+        sig = sig.at[..., idx].add(frames.reshape(lead + (-1,)))
+        env = env.at[idx].add(jnp.tile(win * win, (n_frames,)))
+        sig = sig / jnp.maximum(env, 1e-11)
+        if center:
+            sig = sig[..., n_fft // 2 : out_len - n_fft // 2]
+        if length is not None:
+            sig = sig[..., :length]
+        return sig
+
+    return apply(f, ins, name="istft")

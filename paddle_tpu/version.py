@@ -1,0 +1,27 @@
+"""paddle.version (reference: generated python/paddle/version.py)."""
+
+full_version = "3.0.0-tpu"
+major = "3"
+minor = "0"
+patch = "0"
+rc = "0"
+cuda_version = "False"  # no CUDA — XLA:TPU backend
+cudnn_version = "False"
+xpu_version = "False"
+istaged = True
+commit = "tpu-native"
+with_pip_cuda_libraries = "OFF"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+    print("backend: XLA/TPU (jax)")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

@@ -1,0 +1,194 @@
+"""paddle.vision.ops — detection building blocks (reference:
+python/paddle/vision/ops.py over phi CUDA kernels: nms, roi_align,
+box utilities).  TPU-native: static-shape formulations — NMS as an
+iterative suppression scan over score-sorted boxes, RoIAlign as bilinear
+gathers — all jit-traceable."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops.dispatch import apply, coerce
+
+__all__ = ["nms", "box_area", "box_iou", "roi_align", "psroi_pool", "distribute_fpn_proposals"]
+
+
+def box_area(boxes):
+    """[N, 4] xyxy -> [N] areas."""
+    import jax.numpy as jnp
+
+    boxes = coerce(boxes)
+    return apply(
+        lambda b: (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]), [boxes], name="box_area"
+    )
+
+
+def _iou_matrix(b1, b2):
+    import jax.numpy as jnp
+
+    area1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+    area2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+    lt = jnp.maximum(b1[:, None, :2], b2[None, :, :2])
+    rb = jnp.minimum(b1[:, None, 2:], b2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.clip(area1[:, None] + area2[None, :] - inter, 1e-10, None)
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU [N, M] for xyxy boxes."""
+    boxes1, boxes2 = coerce(boxes1), coerce(boxes2)
+    return apply(_iou_matrix, [boxes1, boxes2], name="box_iou")
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None, top_k=None):
+    """Non-maximum suppression (reference: paddle.vision.ops.nms).
+
+    Returns kept box indices sorted by descending score.  Static-shape
+    suppression scan: O(N^2) IoU matrix + sequential keep mask — the TPU
+    formulation (no data-dependent shapes until the final host-side
+    compaction, which is eager-only like the reference's dynamic output)."""
+    import jax
+    import jax.numpy as jnp
+
+    boxes = coerce(boxes)
+    n = boxes.shape[0]
+    ins = [boxes]
+    if scores is not None:
+        ins.append(coerce(scores))
+    if category_idxs is not None:
+        ins.append(coerce(category_idxs))
+
+    def f(b, *rest):
+        sc = rest[0] if scores is not None else jnp.arange(n, 0, -1, dtype=jnp.float32)
+        order = jnp.argsort(-sc)
+        bs = b[order]
+        iou = _iou_matrix(bs, bs)
+        if category_idxs is not None:
+            cat = rest[-1][order]
+            # cross-category pairs never suppress each other
+            iou = jnp.where(cat[:, None] == cat[None, :], iou, 0.0)
+
+        def body(i, keep):
+            # i suppressed by any kept higher-scoring j with IoU > thresh
+            sup = ((jnp.arange(n) < i) & keep & (iou[i] > iou_threshold)).any()
+            return keep.at[i].set(~sup)
+
+        keep = jax.lax.fori_loop(1, n, body, jnp.ones((n,), bool))
+        return order, keep
+
+    order, keep = apply(f, ins, multi=True, name="nms")
+    # eager compaction to the reference's dynamic result
+    order_np = np.asarray(order.numpy())
+    keep_np = np.asarray(keep.numpy())
+    kept = order_np[keep_np]
+    if top_k is not None:
+        kept = kept[:top_k]
+    from ..tensor import Tensor
+
+    return Tensor(kept.astype(np.int64))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (reference: paddle.vision.ops.roi_align).
+
+    x: [N, C, H, W]; boxes: [R, 4] xyxy in input-image coords;
+    boxes_num: [N] rois per batch image.  Bilinear-gather formulation.
+
+    DEVIATION: the reference's sampling_ratio=-1 adapts the per-bin sample
+    count to each ROI's size (ceil(roi/bin)) — a data-dependent shape XLA
+    cannot compile.  Here -1 uses a static 4x4 in-bin grid (warned once);
+    pass an explicit sampling_ratio for exact reference parity."""
+    import jax.numpy as jnp
+
+    x, boxes, boxes_num = coerce(x), coerce(boxes), coerce(boxes_num)
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) else output_size
+    if sampling_ratio <= 0:
+        import warnings
+
+        warnings.warn(
+            "roi_align: sampling_ratio=-1 uses a static 4x4 in-bin grid on "
+            "TPU (the reference adapts per ROI); pass sampling_ratio "
+            "explicitly for exact parity", stacklevel=2,
+        )
+
+    def f(feat, rois, rois_num):
+        n, c, h, w = feat.shape
+        r = rois.shape[0]
+        # batch index per roi from rois_num
+        batch_idx = jnp.repeat(
+            jnp.arange(n), rois_num, total_repeat_length=r
+        )
+        off = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - off
+        y1 = rois[:, 1] * spatial_scale - off
+        x2 = rois[:, 2] * spatial_scale - off
+        y2 = rois[:, 3] * spatial_scale - off
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.clip(rw, 1.0, None)
+            rh = jnp.clip(rh, 1.0, None)
+        sr = sampling_ratio if sampling_ratio > 0 else 4
+        # sample grid: [R, oh*sr, ow*sr]
+        ys = (
+            y1[:, None]
+            + (jnp.arange(oh * sr) + 0.5)[None, :] * (rh[:, None] / (oh * sr))
+        )
+        xs = (
+            x1[:, None]
+            + (jnp.arange(ow * sr) + 0.5)[None, :] * (rw[:, None] / (ow * sr))
+        )
+
+        def bilinear(img, yy, xx):
+            # img [C, H, W]; yy [P], xx [Q] -> [C, P, Q].  Samples beyond
+            # [-1, H] x [-1, W] contribute ZERO (the reference kernel's
+            # border contract); in-range coords clamp for interpolation.
+            yv = (yy >= -1.0) & (yy <= h)
+            xv = (xx >= -1.0) & (xx <= w)
+            yy = jnp.clip(yy, 0.0, h - 1)
+            xx = jnp.clip(xx, 0.0, w - 1)
+            y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+            y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+            x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+            y0i = y0.astype(jnp.int32)
+            x0i = x0.astype(jnp.int32)
+            wy = jnp.clip(yy - y0, 0.0, 1.0)
+            wx = jnp.clip(xx - x0, 0.0, 1.0)
+            v00 = img[:, y0i][:, :, x0i]
+            v01 = img[:, y0i][:, :, x1i]
+            v10 = img[:, y1i][:, :, x0i]
+            v11 = img[:, y1i][:, :, x1i]
+            out = (
+                v00 * (1 - wy)[None, :, None] * (1 - wx)[None, None, :]
+                + v01 * (1 - wy)[None, :, None] * wx[None, None, :]
+                + v10 * wy[None, :, None] * (1 - wx)[None, None, :]
+                + v11 * wy[None, :, None] * wx[None, None, :]
+            )
+            return out * (yv[:, None] & xv[None, :])[None].astype(out.dtype)
+
+        import jax
+
+        def per_roi(bi, yy, xx):
+            samp = bilinear(feat[bi], yy, xx)  # [C, oh*sr, ow*sr]
+            return samp.reshape(c, oh, sr, ow, sr).mean((2, 4))
+
+        return jax.vmap(per_roi)(batch_idx, ys, xs)  # [R, C, oh, ow]
+
+    return apply(f, [x, boxes, boxes_num], name="roi_align")
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    raise NotImplementedError(
+        "psroi_pool: use roi_align — position-sensitive pooling is not yet "
+        "provided in paddle_tpu"
+    )
+
+
+def distribute_fpn_proposals(*a, **k):
+    raise NotImplementedError(
+        "distribute_fpn_proposals requires dynamic per-level splits; "
+        "assign levels host-side with paddle.vision.ops.box_area"
+    )

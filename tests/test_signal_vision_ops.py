@@ -1,0 +1,194 @@
+"""paddle.signal (stft/istft), paddle.regularizer, paddle.vision.ops
+(round-5 namespace completion; reference python/paddle/{signal,
+regularizer,vision/ops}.py)."""
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+class TestSignal:
+    def test_stft_matches_torch(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 512).astype(np.float32)
+        win = np.hanning(128).astype(np.float32)
+        out = paddle.signal.stft(t(x), n_fft=128, hop_length=64, window=t(win)).numpy()
+        ref = torch.stft(
+            torch.tensor(x), n_fft=128, hop_length=64,
+            window=torch.tensor(win), center=True, pad_mode="reflect",
+            return_complex=True, onesided=True,
+        ).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_istft_roundtrip(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 1024).astype(np.float32)
+        win = np.hanning(256).astype(np.float32)
+        spec = paddle.signal.stft(t(x), n_fft=256, hop_length=64, window=t(win))
+        back = paddle.signal.istft(
+            spec, n_fft=256, hop_length=64, window=t(win), length=1024
+        ).numpy()
+        np.testing.assert_allclose(back, x, rtol=1e-3, atol=1e-4)
+
+    def test_stft_normalized_and_no_window(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(300).astype(np.float32)
+        out = paddle.signal.stft(t(x), n_fft=64, hop_length=32, normalized=True).numpy()
+        ref = torch.stft(
+            torch.tensor(x), n_fft=64, hop_length=32, center=True,
+            pad_mode="reflect", normalized=True, return_complex=True,
+        ).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+class TestRegularizer:
+    def test_l2_decay_equals_float(self):
+        def run(wd):
+            paddle.seed(0)
+            w = paddle.to_tensor(np.ones(4, np.float32))
+            w.stop_gradient = False
+            opt = paddle.optimizer.Momentum(
+                learning_rate=0.1, momentum=0.0, parameters=[w], weight_decay=wd
+            )
+            (w * 2).sum().backward()
+            opt.step()
+            return w.numpy()
+
+        np.testing.assert_allclose(
+            run(paddle.regularizer.L2Decay(0.5)), run(0.5), rtol=1e-6
+        )
+
+    def test_l1_decay_uses_sign(self):
+        w = paddle.to_tensor(np.array([2.0, -3.0], np.float32))
+        w.stop_gradient = False
+        opt = paddle.optimizer.SGD(
+            learning_rate=1.0, parameters=[w],
+            weight_decay=paddle.regularizer.L1Decay(0.25),
+        )
+        (w * 0.0).sum().backward()  # zero grad: update is pure decay
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), [2.0 - 0.25, -3.0 + 0.25], rtol=1e-6)
+
+    def test_adam_l1(self):
+        w = paddle.to_tensor(np.array([1.0, -1.0], np.float32))
+        w.stop_gradient = False
+        opt = paddle.optimizer.Adam(
+            learning_rate=0.1, parameters=[w],
+            weight_decay=paddle.regularizer.L1Decay(0.1),
+        )
+        (w * 2).sum().backward()
+        opt.step()
+        assert np.isfinite(w.numpy()).all()
+
+
+class TestVisionOps:
+    def test_box_iou_and_area(self):
+        b1 = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+        b2 = np.array([[0, 0, 2, 2], [2, 2, 4, 4]], np.float32)
+        iou = paddle.vision.ops.box_iou(t(b1), t(b2)).numpy()
+        np.testing.assert_allclose(iou[0, 0], 1.0, rtol=1e-6)
+        np.testing.assert_allclose(iou[0, 1], 0.0, atol=1e-7)
+        np.testing.assert_allclose(
+            paddle.vision.ops.box_area(t(b1)).numpy(), [4.0, 4.0]
+        )
+
+    def test_nms_matches_numpy_reference(self):
+        def np_nms(boxes, scores, thresh):
+            order = np.argsort(-scores)
+            keep = []
+            while order.size:
+                i = order[0]
+                keep.append(i)
+                rest = order[1:]
+                x1 = np.maximum(boxes[i, 0], boxes[rest, 0])
+                y1 = np.maximum(boxes[i, 1], boxes[rest, 1])
+                x2 = np.minimum(boxes[i, 2], boxes[rest, 2])
+                y2 = np.minimum(boxes[i, 3], boxes[rest, 3])
+                inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+                a = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+                iou = inter / (a[i] + a[rest] - inter)
+                order = rest[iou <= thresh]
+            return np.array(keep)
+
+        rng = np.random.RandomState(3)
+        xy = rng.rand(30, 2).astype(np.float32) * 10
+        wh = rng.rand(30, 2).astype(np.float32) * 5 + 1
+        boxes = np.concatenate([xy, xy + wh], -1)
+        scores = rng.rand(30).astype(np.float32)
+        kept = paddle.vision.ops.nms(t(boxes), 0.4, scores=t(scores)).numpy()
+        ref = np_nms(boxes, scores, 0.4)
+        np.testing.assert_array_equal(kept, ref)
+
+    def test_roi_align_linear_ramp_analytic(self):
+        # bilinear sampling of a LINEAR ramp is exact, and averaging the
+        # sr x sr in-bin samples gives the bin-center value — so on
+        # feat[c, y, x] = x the expected output is analytic
+        ramp = np.tile(np.arange(16, dtype=np.float32), (1, 1, 16, 1))  # [1,1,16,16]
+        boxes = np.array([[2.0, 2.0, 10.0, 10.0]], np.float32)
+        boxes_num = np.array([1], np.int32)
+        oh = ow = 4
+        out = paddle.vision.ops.roi_align(
+            t(ramp), t(boxes), t(boxes_num), output_size=4, spatial_scale=1.0,
+            sampling_ratio=2, aligned=True,
+        ).numpy()
+        x1 = 2.0 - 0.5
+        rw = 8.0
+        expected_cols = x1 + (np.arange(ow) + 0.5) * (rw / ow)
+        np.testing.assert_allclose(out[0, 0], np.tile(expected_cols, (oh, 1)), rtol=1e-5)
+
+    def test_roi_align_batch_routing(self):
+        # rois route to their batch image via boxes_num
+        x = np.zeros((2, 1, 8, 8), np.float32)
+        x[0] = 1.0
+        x[1] = 5.0
+        boxes = np.array([[1, 1, 5, 5], [1, 1, 5, 5]], np.float32)
+        out = paddle.vision.ops.roi_align(
+            t(x), t(boxes), t(np.array([1, 1], np.int32)), output_size=2
+        ).numpy()
+        np.testing.assert_allclose(out[0], np.ones((1, 2, 2)))
+        np.testing.assert_allclose(out[1], np.full((1, 2, 2), 5.0))
+
+    def test_version(self):
+        assert paddle.__version__ == paddle.version.full_version
+        assert paddle.version.major == "3"
+
+
+class TestReviewRegressions:
+    def test_stft_complex_onesided_raises(self):
+        c = (np.random.rand(64) + 1j * np.random.rand(64)).astype(np.complex64)
+        with pytest.raises(ValueError, match="onesided"):
+            paddle.signal.stft(t(c), n_fft=32)
+        out = paddle.signal.stft(t(c), n_fft=32, onesided=False)
+        assert out.shape[0] == 32  # full spectrum
+
+    def test_roi_align_border_zeros(self):
+        # samples beyond [-1, H] contribute zero, not edge replication
+        x = np.ones((1, 1, 8, 8), np.float32)
+        boxes = np.array([[-8.0, -8.0, 8.0, 8.0]], np.float32)
+        out = paddle.vision.ops.roi_align(
+            t(x), t(boxes), t(np.array([1], np.int32)), output_size=2,
+            sampling_ratio=2, aligned=True,
+        ).numpy()
+        # top-left bin samples land far outside -> zeroed
+        assert out[0, 0, 0, 0] < 0.5
+        assert out[0, 0, 1, 1] > 0.5  # interior bin sees real data
+
+    def test_lamb_l1_decay_sign(self):
+        w = paddle.to_tensor(np.array([2.0, -2.0], np.float32))
+        w.stop_gradient = False
+        opt = paddle.optimizer.Lamb(
+            learning_rate=0.1, parameters=[w],
+            lamb_weight_decay=paddle.regularizer.L1Decay(0.5),
+        )
+        (w * 0.0).sum().backward()
+        opt.step()
+        out = w.numpy()
+        # pure L1 decay: both entries shrink toward zero SYMMETRICALLY
+        np.testing.assert_allclose(out[0], -out[1], rtol=1e-5)
+        assert abs(out[0]) < 2.0

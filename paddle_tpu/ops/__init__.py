@@ -208,3 +208,62 @@ Tensor.zero_ = _manipulation.zero_
 Tensor.uniform_ = _random.uniform_
 Tensor.normal_ = _random.normal_
 Tensor.set_value = _manipulation.set_value_
+
+# round-5 method aliases (reference Tensor surface / torch-compat names)
+Tensor.ndimension = lambda s: s.ndim
+Tensor.nelement = lambda s: s.size
+Tensor.sub = _math.subtract
+Tensor.sub_ = _math.subtract_
+Tensor.mul = _math.multiply
+Tensor.mul_ = _math.multiply_
+Tensor.div = _math.divide
+Tensor.div_ = _math.divide_
+Tensor.clamp = _math.clip
+Tensor.clamp_ = _math.clip_
+Tensor.T = property(lambda s: _manipulation.transpose(s))  # perm=None reverses
+Tensor.mT = property(
+    lambda s: _manipulation.transpose(
+        s, list(range(s.ndim - 2)) + [s.ndim - 1, s.ndim - 2]
+    )
+)
+
+
+def _copy_(self, other):
+    """In-place copy from another tensor (reference: Tensor.copy_ requires
+    matching shapes); payload replacement delegates to set_value_."""
+    from .dispatch import coerce
+
+    other = coerce(other)
+    if tuple(other.shape) != tuple(self.shape):
+        raise ValueError(
+            f"copy_: shape mismatch — source {list(other.shape)} vs "
+            f"destination {list(self.shape)}"
+        )
+    return _manipulation.set_value_(self, other)
+
+
+Tensor.copy_ = _copy_
+
+
+def _retain_grads(self):
+    """Make .grad available on a non-leaf after backward (reference:
+    Tensor.retain_grads): a weak grad hook accumulates the cotangent into
+    .grad — the engine already applies output hooks to non-leaves."""
+    if getattr(self, "_retains_grad", False):
+        return self
+    self._retains_grad = True
+    import weakref
+
+    wr = weakref.ref(self)
+
+    def hook(g):
+        t_ = wr()
+        if t_ is not None:
+            t_.grad = g if t_.grad is None else t_.grad + g
+        return g
+
+    self.register_hook(hook)
+    return self
+
+
+Tensor.retain_grads = _retain_grads

@@ -38,6 +38,7 @@ class Tensor:
         "name",
         "_trainable",
         "_hooks",
+        "_retains_grad",
         "placements",
         "process_mesh",
         "sequence_parallel",

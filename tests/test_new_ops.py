@@ -651,3 +651,41 @@ class TestRound5LinalgAndLosses:
         out = paddle.cumulative_trapezoid(t(y), x=t(xs), axis=0).numpy()
         ref = si.cumulative_trapezoid(y, xs, axis=0)
         np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestRound5TensorMethods:
+    def test_method_aliases(self):
+        x = t(np.arange(6, dtype=np.float32).reshape(2, 3))
+        assert x.T.shape == [3, 2] and x.mT.shape == [3, 2]
+        x3 = t(np.zeros((2, 3, 4), np.float32))
+        assert x3.mT.shape == [2, 4, 3]
+        assert x.ndimension() == 2 and x.nelement() == 6
+        np.testing.assert_allclose(x.clamp(1.0, 4.0).numpy().max(), 4.0)
+        np.testing.assert_allclose(x.sub(x).numpy(), np.zeros((2, 3)))
+        np.testing.assert_allclose(x.mul(x).numpy(), (np.arange(6) ** 2).reshape(2, 3))
+        y = t(np.zeros((2, 3), np.float32))
+        y.copy_(x)
+        np.testing.assert_allclose(y.numpy(), x.numpy())
+        assert x.retain_grads() is x
+
+    def test_inplace_aliases_rebind(self):
+        x = t(np.full((3,), 10.0, np.float32))
+        x.sub_(t(np.ones(3, np.float32)))
+        np.testing.assert_allclose(x.numpy(), [9.0, 9.0, 9.0])
+        x.div_(t(np.full(3, 3.0, np.float32)))
+        np.testing.assert_allclose(x.numpy(), [3.0, 3.0, 3.0])
+        x.clamp_(min=2.5)
+        np.testing.assert_allclose(x.numpy(), [3.0, 3.0, 3.0])
+
+    def test_retain_grads_non_leaf(self):
+        x = t(np.array([2.0, 3.0], np.float32), rg=True)
+        y = x * 2.0
+        y.retain_grads()
+        (y * y).sum().backward()
+        np.testing.assert_allclose(y.grad.numpy(), 2 * (2 * x.numpy()))  # d/dy y^2
+        np.testing.assert_allclose(x.grad.numpy(), 8 * x.numpy())
+
+    def test_copy_shape_mismatch_raises(self):
+        a = t(np.zeros((2, 3), np.float32))
+        with pytest.raises(ValueError, match="copy_"):
+            a.copy_(t(np.ones(5, np.float32)))

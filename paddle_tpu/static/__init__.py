@@ -430,5 +430,30 @@ class nn:
         return list(_tree_restore(tpl, outs))
 
     @staticmethod
-    def fc(x, size, **kwargs):
-        raise NotImplementedError("static fluid layers are superseded by paddle_tpu.nn")
+    def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+           activation=None, name=None):
+        """static.nn.fc (reference: python/paddle/static/nn/common.py fc):
+        flatten trailing dims, apply a fresh Linear, optional activation.
+        Weights are created per call (the reference keys them into the
+        Program; here the imperative nn.Linear owns them — reuse a
+        nn.Linear directly for shared weights)."""
+        from .. import nn as _nn
+        from ..ops.dispatch import coerce
+
+        x = coerce(x)
+        if not 1 <= num_flatten_dims < x.ndim:
+            raise ValueError(
+                f"fc: num_flatten_dims must be in [1, {x.ndim - 1}] for a "
+                f"rank-{x.ndim} input, got {num_flatten_dims}"
+            )
+        flat = 1
+        for d in x.shape[num_flatten_dims:]:
+            flat *= d
+        lead = list(x.shape[:num_flatten_dims])
+        layer = _nn.Linear(flat, size, weight_attr=weight_attr, bias_attr=bias_attr)
+        out = layer(x.reshape(lead + [flat]))
+        if activation is not None:
+            import paddle_tpu.nn.functional as F
+
+            out = getattr(F, activation)(out)
+        return out

@@ -354,3 +354,22 @@ class TestControlFlow:
             lambda i: i < 3, lambda i: [i + 1], [t(np.int32(0))], max_iters=10
         )
         assert int(out[0].numpy()) == 3
+
+
+def test_static_nn_fc():
+    import paddle_tpu.static as static
+
+    x = t(np.random.RandomState(0).rand(4, 2, 3).astype(np.float32))
+    out = static.nn.fc(x, size=5, num_flatten_dims=1, activation="relu")
+    assert out.shape == [4, 5]
+    assert (out.numpy() >= 0).all()
+
+
+def test_static_nn_fc_bad_flatten_dims():
+    import paddle_tpu.static as static
+
+    x = t(np.ones((4, 2, 3), np.float32))
+    with pytest.raises(ValueError, match="num_flatten_dims"):
+        static.nn.fc(x, 5, num_flatten_dims=0)
+    with pytest.raises(ValueError, match="num_flatten_dims"):
+        static.nn.fc(x, 5, num_flatten_dims=3)

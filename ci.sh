@@ -20,11 +20,14 @@ echo "== native tests =="
 ./csrc/build/core_test
 
 echo "== python suite (8-device CPU mesh) =="
-PYTEST_ARGS=""
-[ "$MODE" = "fast" ] && PYTEST_ARGS="-x"
+# chaos/fault-tolerance tests (tests/test_fault_tolerance.py) run here too;
+# the multi-process restart-resume test is @pytest.mark.slow and is skipped
+# in fast mode (tier-1 runs with -m 'not slow' as well)
+PYTEST_ARGS=()
+[ "$MODE" = "fast" ] && PYTEST_ARGS=(-x -m "not slow")
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
-    python -m pytest tests/ -q $PYTEST_ARGS
+    python -m pytest tests/ -q "${PYTEST_ARGS[@]+${PYTEST_ARGS[@]}}"
 
 if [ "$MODE" != "fast" ]; then
   echo "== bench smoke (CPU) =="

@@ -146,6 +146,10 @@ class GradScaler:
         self._decr_every = decr_every_n_nan_or_inf
         self._dynamic = use_dynamic_loss_scaling
         self._found_inf = None  # None | Tensor(bool scalar) — eager cycle
+        # whether the LAST completed update() cycle skipped the step on an
+        # inf/nan — fault.Supervisor reads this to count scaler-skipped
+        # steps against its non-finite budget without re-scanning grads
+        self.last_found_inf = False
         # per-optimizer step state: INIT -> UNSCALED -> STEPPED, reset by
         # update() (reference: OptimizerState in python/paddle/amp/
         # grad_scaler.py).  Overloading _found_inf for this caused the
@@ -320,6 +324,11 @@ class GradScaler:
             return
         c = self._cycle()
         found = c.found if c is not None else self._found_inf
+        if found is not None:
+            import jax
+
+            if not isinstance(found._data, jax.core.Tracer):
+                self.last_found_inf = bool(found.numpy())
         if c is None and found is None and self._pending_traced_update:
             self._pending_traced_update = False  # one-shot: eager cycles resume
             raise RuntimeError(

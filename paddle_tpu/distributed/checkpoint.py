@@ -17,12 +17,18 @@ FLAGS_checkpoint_fallback_npz for single-host debugging only.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
+import re
+import shutil
+import time
+import zlib
 
 import numpy as np
 import jax
 
+from ..fault import injection as _inj
 from ..framework import core as _core
 from ..tensor import Tensor
 
@@ -31,6 +37,20 @@ _core.define_flag(
     False,
     "fall back to a replicated .npz when orbax save fails (single-host debug only)",
 )
+_core.define_flag(
+    "FLAGS_checkpoint_save_retries",
+    3,
+    "bounded retries around a failed checkpoint save before raising",
+)
+_core.define_flag(
+    "FLAGS_checkpoint_retry_backoff",
+    0.5,
+    "initial retry backoff (seconds), doubled per attempt",
+)
+
+_inj.register("checkpoint.save", "fires inside each save attempt, before orbax writes")
+_inj.register("checkpoint.commit", "fires after data is written, before the COMMIT marker — leaves a torn checkpoint")
+_inj.register("checkpoint.load", "fires before restoring a checkpoint")
 
 logger = logging.getLogger("paddle_tpu")
 
@@ -68,6 +88,7 @@ def wait_all():
 
 
 def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0, async_save=False):
+    _inj.inject("checkpoint.save", context=path)
     flat = _flatten_sd(state_dict)
     os.makedirs(path, exist_ok=True)
     arrays = {
@@ -110,6 +131,7 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0, of
     distributed/checkpoint/load_state_dict.py reshard protocol) — never a
     full-array numpy round trip.  `load_state_dict.last_restore_mode`
     records which path ran, for tests and debugging."""
+    _inj.inject("checkpoint.load", context=path)
     wait_all()
     flat = _flatten_sd(state_dict)
     state_dir = os.path.join(path, "state")
@@ -170,3 +192,242 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0, of
 
 
 load_state_dict.last_restore_mode = None
+
+
+# ---------------------------------------------------------------------------
+# Hardened checkpoints: atomic commit, validity scan, auto-resume, retention
+# ---------------------------------------------------------------------------
+#
+# Layout under a checkpoint root:
+#   root/step_12/           committed checkpoint (COMMIT marker present)
+#   root/step_17.tmp/       in-flight or torn save — never resumed from
+#
+# Commit protocol: write all data into step_N.tmp, write the COMMIT
+# manifest (per-array shapes/dtypes/crc32) inside it, fsync, then a single
+# atomic rename step_N.tmp -> step_N and an fsync of the root directory.
+# A crash at ANY point leaves either a committed checkpoint or a .tmp the
+# validity scan ignores — never a half-checkpoint a resume can trust.
+
+COMMIT_FILE = "COMMIT"
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+class CheckpointCorruption(RuntimeError):
+    """A committed checkpoint failed validation (torn write, bit rot)."""
+
+
+def _is_lead():
+    try:
+        return jax.process_index() == 0
+    except RuntimeError:
+        return True
+
+
+def _crc32(arr):
+    """crc32 of the array payload; None when the bytes aren't local (a
+    multi-host sharded array — validated by orbax's own integrity instead)."""
+    try:
+        if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+            return None
+        return zlib.crc32(np.ascontiguousarray(np.asarray(arr)).tobytes()) & 0xFFFFFFFF
+    except Exception:
+        return None
+
+
+def _fsync_dir(path):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass  # e.g. directories not fsync-able on this filesystem
+
+
+def step_dir(root, step):
+    return os.path.join(root, f"step_{int(step)}")
+
+
+def save_checkpoint(state_dict, root, step, keep_last_n=None, retries=None, backoff=None):
+    """Atomically commit `state_dict` as `root/step_<step>`.
+
+    Save failures (orbax errors, injected faults) are retried with
+    exponential backoff (`FLAGS_checkpoint_save_retries` /
+    `FLAGS_checkpoint_retry_backoff`) before raising; a crash mid-save
+    leaves only a `.tmp` directory that `find_latest_valid` skips.
+    `keep_last_n` prunes older committed checkpoints (and stale .tmp
+    leftovers) after a successful commit.  Synchronous by design: the
+    COMMIT marker asserts the bytes are durable, which an async save
+    cannot promise at return time.  Returns the committed path.
+    """
+    if retries is None:
+        retries = int(_core.flag("FLAGS_checkpoint_save_retries"))
+    if backoff is None:
+        backoff = float(_core.flag("FLAGS_checkpoint_retry_backoff"))
+    os.makedirs(root, exist_ok=True)
+    final = step_dir(root, step)
+    tmp = final + ".tmp"
+
+    attempt = 0
+    while True:
+        try:
+            if os.path.isdir(tmp):
+                shutil.rmtree(tmp)  # debris from a previous torn attempt
+            save_state_dict(state_dict, tmp)
+            break
+        except Exception as e:
+            attempt += 1
+            if attempt > retries:
+                raise RuntimeError(
+                    f"checkpoint save for step {step} failed after {attempt} "
+                    f"attempt(s): {e}"
+                ) from e
+            delay = backoff * (2 ** (attempt - 1))
+            logger.warning(
+                "checkpoint save attempt %d/%d failed (%s); retrying in %.2fs",
+                attempt, retries + 1, e, delay,
+            )
+            time.sleep(delay)
+
+    flat = _flatten_sd(state_dict)
+    manifest = {"step": int(step), "time": time.time(), "arrays": {}}
+    for k, v in flat.items():
+        arr = v._raw if isinstance(v, Tensor) else np.asarray(v)
+        manifest["arrays"][k] = {
+            "shape": [int(s) for s in np.shape(arr)],
+            "dtype": str(getattr(arr, "dtype", np.asarray(arr).dtype)),
+            "crc32": _crc32(arr),
+        }
+
+    # chaos point: data durable, marker absent — the torn-checkpoint state
+    _inj.inject("checkpoint.commit", context=tmp)
+
+    if jax.process_count() > 1:
+        # every host finished writing its shards before anyone commits
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"ckpt_commit_{step}")
+    if _is_lead():
+        commit = os.path.join(tmp, COMMIT_FILE)
+        with open(commit, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_dir(tmp)
+        if os.path.isdir(final):
+            shutil.rmtree(final)  # re-saving a step replaces it atomically
+        os.rename(tmp, final)
+        _fsync_dir(root)
+        if keep_last_n:
+            _prune(root, keep_last_n, current_step=int(step))
+    return final
+
+
+def _prune(root, keep_last_n, current_step=None):
+    steps = sorted((s for s, _ in _committed_steps(root)), reverse=True)
+    for s in steps[int(keep_last_n):]:
+        shutil.rmtree(step_dir(root, s), ignore_errors=True)
+    for name in os.listdir(root):
+        if name.endswith(".tmp") and _STEP_RE.match(name[:-4]):
+            s = int(_STEP_RE.match(name[:-4]).group(1))
+            if current_step is None or s != current_step:
+                shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+
+
+def _committed_steps(root):
+    """[(step, path)] of directories that pass the lightweight validity
+    check: committed name (no .tmp), parseable COMMIT marker, data present."""
+    out = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return out
+    for name in names:
+        m = _STEP_RE.match(name)
+        if not m:
+            continue
+        path = os.path.join(root, name)
+        if read_commit_manifest(path) is None:
+            continue
+        out.append((int(m.group(1)), path))
+    return out
+
+
+def read_commit_manifest(path):
+    """The COMMIT manifest of a checkpoint dir, or None if it is missing/
+    unparseable or the data payload is absent (torn checkpoint)."""
+    try:
+        with open(os.path.join(path, COMMIT_FILE)) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not (
+        os.path.isdir(os.path.join(path, "state"))
+        or os.path.exists(os.path.join(path, "state.npz"))
+    ):
+        return None
+    return manifest
+
+
+def find_latest_valid(root):
+    """Newest committed checkpoint under `root` as (step, path), or None.
+
+    Skips torn/in-flight saves (.tmp dirs, missing/corrupt COMMIT marker,
+    missing payload) — the contract that makes auto-resume safe after a
+    crash mid-save."""
+    steps = _committed_steps(root)
+    if not steps:
+        return None
+    return max(steps, key=lambda sp: sp[0])
+
+
+def verify_checkpoint(state_dict, path):
+    """Compare restored tensors against the COMMIT manifest's per-array
+    crc32 (where recorded).  Raises CheckpointCorruption on mismatch."""
+    manifest = read_commit_manifest(path)
+    if manifest is None:
+        raise CheckpointCorruption(f"no valid COMMIT manifest under {path!r}")
+    flat = _flatten_sd(state_dict)
+    for k, meta in manifest.get("arrays", {}).items():
+        want = meta.get("crc32")
+        t = flat.get(k)
+        if want is None or not isinstance(t, Tensor):
+            # non-Tensor leaves (step counters, python scalars) cannot be
+            # restored in place by load_state_dict, so the live value is
+            # legitimately the fresh process's — nothing to verify against
+            continue
+        got = _crc32(t._raw)
+        if got is not None and got != want:
+            raise CheckpointCorruption(
+                f"checkpoint {path!r}: array {k!r} checksum mismatch "
+                f"(manifest {want}, restored {got})"
+            )
+
+
+def load_latest(state_dict, root=None, verify=True):
+    """Resume from the newest VALID checkpoint under `root` (default: the
+    $PADDLE_CKPT_DIR the launch controller exports).
+
+    Tries committed checkpoints newest-first; one that fails to restore or
+    fails checksum verification is logged and skipped in favor of the next
+    older — a torn or bit-rotted latest checkpoint degrades the resume
+    point, never the job.  Returns the resumed step, or None when nothing
+    valid exists (fresh start)."""
+    root = root or os.environ.get("PADDLE_CKPT_DIR") or ""
+    if not root:
+        return None
+    candidates = sorted(_committed_steps(root), key=lambda sp: sp[0], reverse=True)
+    for step, path in candidates:
+        try:
+            load_state_dict(state_dict, path)
+            if verify:
+                verify_checkpoint(state_dict, path)
+            logger.info("resumed from checkpoint step %d (%s)", step, path)
+            return step
+        except Exception as e:
+            logger.warning(
+                "checkpoint %s unusable (%s); falling back to an older one", path, e
+            )
+    logger.warning("no usable checkpoint under %r; starting fresh", root)
+    return None

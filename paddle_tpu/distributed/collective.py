@@ -234,6 +234,9 @@ def _blocks_view(a, d, n):
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    from ..fault import injection as _inj
+
+    _inj.inject("collective.all_reduce")
     g = _get_group(group)
     axis = g.axis_name
     t = coerce(tensor)

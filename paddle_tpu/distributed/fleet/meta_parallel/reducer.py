@@ -37,18 +37,26 @@ _XPROC_CACHE = {}
 
 
 def _cross_process_mean(arr):
-    """Average a process-local flat bucket across all processes.
+    """Average a process-local flat bucket across all processes — one
+    contribution PER PROCESS, regardless of how many devices each holds.
 
     The local bucket is placed on each local device as one [1, n] shard of
     a global [n_devices, n] array over a 1-axis mesh; a cached compiled
-    `mean(axis=0)` (replicated output) runs as one SPMD program — XLA
+    `sum(axis=0)` (replicated output) runs as one SPMD program — XLA
     lowers it to an all-reduce, and no host ever holds a stacked
-    [world, n] array.  Every process must flush buckets in the same order
-    (they do: bucket assignment is deterministic), the usual collective
-    contract."""
+    [world, n] array.  Each local shard is pre-scaled by
+    1 / (process_count * local_device_count): a process contributes
+    exactly arr / process_count however many devices it has, so the
+    result is the true per-process mean even on heterogeneous topologies
+    (a plain mean over the device axis would silently weight each process
+    by its local device count).  Every process must flush buckets in the
+    same order (they do: bucket assignment is deterministic), the usual
+    collective contract."""
     import numpy as np
     from jax.sharding import Mesh, NamedSharding
     from jax.sharding import PartitionSpec as P
+
+    import jax.numpy as jnp
 
     key = (tuple(arr.shape), str(arr.dtype))
     ent = _XPROC_CACHE.get(key)
@@ -57,18 +65,19 @@ def _cross_process_mean(arr):
         mesh = Mesh(devs, ("d",))
         in_s = NamedSharding(mesh, P("d"))
         out_s = NamedSharding(mesh, P())
-
-        import jax.numpy as jnp
+        out_dtype = jnp.dtype(arr.dtype)
 
         fn = jax.jit(
-            lambda a: a.astype(jnp.float32).mean(0).astype(a.dtype),
+            lambda a: a.sum(0).astype(out_dtype),
             in_shardings=in_s,
             out_shardings=out_s,
         )
         ent = (mesh, in_s, fn)
         _XPROC_CACHE[key] = ent
     mesh, in_s, fn = ent
-    shards = [jax.device_put(arr[None], d) for d in jax.local_devices()]
+    scale = 1.0 / (jax.process_count() * len(jax.local_devices()))
+    local = arr.astype(jnp.float32) * scale
+    shards = [jax.device_put(local[None], d) for d in jax.local_devices()]
     garr = jax.make_array_from_single_device_arrays(
         (len(mesh.devices.ravel()),) + tuple(arr.shape), in_s, shards
     )

@@ -496,7 +496,11 @@ def ring_attention_array(q, k, v, axis_name="sep", causal=True, scale=None, mesh
         # (2 rectangular offset-causal kernels/device); larger KV rotates
         # hop-by-hop around the ring.
         fwd_idx, inv_idx = _zigzag_perm(S, R)
-        kv_bytes = S * q.shape[2] * q.shape[3] * 2 * np.dtype(q.dtype).itemsize
+        # gathered-KV footprint is the FULL [b, S, h, d] K and V per device:
+        # the batch dimension must be in the budget or batch>1 blows past it
+        kv_bytes = (
+            q.shape[0] * S * q.shape[2] * q.shape[3] * 2 * np.dtype(q.dtype).itemsize
+        )
         if kv_bytes <= _GATHERED_KV_MAX_BYTES:
             # only q (and the output) need the zig-zag layout — K/V stay
             # contiguous-sharded and never pay a global permute

@@ -32,6 +32,12 @@ import subprocess
 import sys
 import time
 
+# EX_TEMPFAIL: a trainer exiting with this code ASKS to be relaunched
+# (preemption drained via fault.Supervisor) — same restart budget, but
+# logged as requested rather than as a crash.  Kept as a literal so the
+# controller stays importable without the paddle_tpu runtime.
+RESTART_EXIT_CODE = 75
+
 
 def _free_port():
     s = socket.socket()
@@ -53,7 +59,23 @@ def parse_args(argv=None):
     p.add_argument("--devices", "--gpus", type=str, default="", dest="devices")
     p.add_argument("--log_dir", type=str, default="log")
     p.add_argument("--run_mode", type=str, default="collective")
-    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument(
+        "--max_restart", "--max_restarts", type=int, default=3, dest="max_restart",
+        help="restart budget: give up after this many relaunches",
+    )
+    p.add_argument(
+        "--restart_backoff", type=float, default=1.0,
+        help="initial delay before a relaunch (s), doubled per consecutive restart",
+    )
+    p.add_argument(
+        "--restart_backoff_max", type=float, default=30.0,
+        help="cap on the exponential restart backoff (s)",
+    )
+    p.add_argument(
+        "--ckpt_dir", type=str, default=os.environ.get("PADDLE_CKPT_DIR", ""),
+        help="checkpoint root exported to trainers as PADDLE_CKPT_DIR; a "
+        "relaunched trainer auto-resumes via distributed.checkpoint.load_latest",
+    )
     p.add_argument("--host", type=str, default="")
     p.add_argument("--hb_interval", type=float, default=2.0, help="heartbeat period (s)")
     p.add_argument("--hb_timeout", type=float, default=10.0, help="declare a node dead after this many seconds without a heartbeat")
@@ -78,6 +100,12 @@ class Container:
         self.log_file = None
 
     def start(self):
+        try:  # chaos point: a trainer that dies at spawn (bad image, OOM)
+            from ...fault import injection as _inj
+
+            _inj.inject("launch.spawn", context=f"rank {self.rank}")
+        except ImportError:
+            pass
         env = dict(os.environ)
         env.update(
             PADDLE_TRAINER_ID=str(self.rank),
@@ -139,6 +167,7 @@ class CollectiveController:
         self.epoch = 0
         self.my_host = args.host or "127.0.0.1"
         self._hb_seen = {}  # node_id -> (counter, local time of last change)
+        self._restarts = 0  # lives consumed from the restart budget
 
     # -- store / rendezvous ------------------------------------------------
     def _connect_store(self):
@@ -210,6 +239,11 @@ class CollectiveController:
         else:
             endpoints = [f"127.0.0.1:{_free_port()}" for _ in range(world)]
             extra = {}
+        # resume contract: relaunched trainers learn where to look for the
+        # newest valid checkpoint and which life they are on
+        extra["PADDLE_RESTART_NUM"] = str(self._restarts)
+        if args.ckpt_dir:
+            extra["PADDLE_CKPT_DIR"] = args.ckpt_dir
         self.containers = []
         for lr in range(nproc):
             grank = node_erank * nproc + lr
@@ -234,8 +268,15 @@ class CollectiveController:
 
         restarts = 0
         while True:
-            self._spawn(node_erank, n_nodes, node_eps)
-            code = self.watch(multi, n_nodes)
+            self._restarts = restarts
+            try:
+                self._spawn(node_erank, n_nodes, node_eps)
+                code = self.watch(multi, n_nodes)
+            except Exception as e:
+                # a failed spawn is supervised like a crashed child: backoff
+                # and retry within the same restart budget
+                print(f"[launch] spawn failed: {e}", file=sys.stderr)
+                code = 1
             for c in self.containers:
                 c.terminate()
             if code == 0:
@@ -258,18 +299,29 @@ class CollectiveController:
             if restarts > args.max_restart:
                 print(f"[launch] giving up after {restarts - 1} restarts", file=sys.stderr)
                 return code
+            # exponential backoff: a crash-looping trainer must not hammer
+            # the pod (or the rendezvous master) at full speed
+            delay = min(
+                args.restart_backoff * (2 ** (restarts - 1)),
+                args.restart_backoff_max,
+            )
+            why = (
+                "requested restart (preemption drain)"
+                if code == RESTART_EXIT_CODE
+                else f"failed (exit {code})"
+            )
             print(
-                f"[launch] child failed (exit {code}); restart {restarts}/{args.max_restart}",
+                f"[launch] child {why}; restart {restarts}/{args.max_restart} "
+                f"in {delay:.1f}s",
                 file=sys.stderr,
             )
+            time.sleep(delay)
             if multi:
                 # a restarted trainer cannot rejoin a live jax.distributed
                 # job: force a job-level epoch restart instead
                 self.store.set(f"bump/{self.epoch + 1}", "1")
                 self.epoch += 1
                 node_erank, n_nodes, node_eps = self._rendezvous(self.epoch)
-            else:
-                time.sleep(1)
 
     # -- watch -------------------------------------------------------------
     def _heartbeat(self, now):

@@ -1,0 +1,41 @@
+"""paddle_tpu.fault — fault injection + supervised recovery.
+
+The resilience contract (SURVEY §2.2/§5.3 Controller→Job/Pod elastic
+restart, §5.4 resume) is only credible if a failure can be *produced* on
+demand and the recovery path *watched*.  This package provides:
+
+- a registry of named fault points (``fault.inject("checkpoint.save")``)
+  threaded through checkpoint save/load, collectives, the launch
+  supervisor, and the data loader.  Faults are armed via
+  ``FLAGS_fault_inject`` (flag or environment variable), so chaos tests
+  and real runs exercise the SAME code path;
+- ``Supervisor`` — a step-loop guard that counts consecutive non-finite
+  losses (reusing the AMP scaler's skip-step signal), turns
+  SIGTERM/preemption into a best-effort checkpoint plus a
+  restart-requested exit, and aborts with a diagnostic instead of
+  burning accelerator time on a diverged job.
+
+Exit-code contract with ``paddle_tpu.distributed.launch``: a trainer
+exiting with ``RESTART_EXIT_CODE`` (75, EX_TEMPFAIL) asks the launcher
+to relaunch it (with exponential backoff, bounded by ``--max_restarts``)
+and to point it at the checkpoint tree via ``PADDLE_CKPT_DIR``.
+"""
+
+from __future__ import annotations
+
+from .injection import (  # noqa: F401
+    InjectedFault,
+    arm,
+    disarm,
+    fault_points,
+    hits,
+    inject,
+    register,
+)
+from .supervisor import (  # noqa: F401
+    RESTART_EXIT_CODE,
+    NonFiniteLossError,
+    RestartRequested,
+    Supervisor,
+    run_supervised,
+)

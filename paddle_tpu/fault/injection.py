@@ -1,0 +1,158 @@
+"""Named fault points, armed via FLAGS_fault_inject.
+
+Spec grammar (comma separated)::
+
+    FLAGS_fault_inject="checkpoint.save:2,dataloader.next"
+
+``name``      fire once (the first time the point is reached)
+``name:N``    fire on the first N hits, then pass through
+``name:*``    fire on every hit
+
+A firing point raises :class:`InjectedFault` — a distinct exception type
+so recovery code can tell a chaos fault from a real error when it wants
+to, while everything written against ``Exception`` (retry loops, the
+launch supervisor) treats it exactly like the production failure it
+stands in for.
+
+Disarmed points cost one dict lookup on an empty dict; hot paths (the
+data loader batch loop, collectives) can call :func:`inject`
+unconditionally.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from ..framework import core as _core
+
+logger = logging.getLogger("paddle_tpu")
+
+_core.define_flag(
+    "FLAGS_fault_inject",
+    "",
+    "comma-separated fault points to arm: name[:count|*] "
+    "(e.g. 'checkpoint.save:2,dataloader.next')",
+)
+
+ALWAYS = -1  # sentinel count for 'name:*'
+
+_lock = threading.Lock()
+_registry = {}  # name -> doc (every point ever declared or reached)
+_armed = {}  # name -> remaining fire count (ALWAYS = unlimited)
+_hits = {}  # name -> times an ARMED point was reached
+_parsed_spec = None  # last spec parsed into _armed (re-parse on change)
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an armed fault point standing in for a real failure."""
+
+    def __init__(self, point, context=None):
+        self.point = point
+        self.context = context
+        msg = f"injected fault at point {point!r}"
+        if context:
+            msg += f" ({context})"
+        super().__init__(msg)
+
+
+def register(name, doc=""):
+    """Declare a fault point (documentation + typo detection for arm())."""
+    _registry.setdefault(name, doc)
+    return name
+
+
+def fault_points():
+    """All known fault points: {name: doc}."""
+    return dict(_registry)
+
+
+def _parse_spec(spec):
+    armed = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, _, count = entry.partition(":")
+        if not count:
+            n = 1
+        elif count == "*":
+            n = ALWAYS
+        else:
+            try:
+                n = int(count)
+            except ValueError:
+                raise ValueError(
+                    f"FLAGS_fault_inject entry {entry!r}: count must be an "
+                    "integer or '*'"
+                ) from None
+        armed[name] = n
+    return armed
+
+
+def _sync_from_flag():
+    """Re-parse FLAGS_fault_inject if it changed since the last sync (so
+    paddle.set_flags / env arming and programmatic arm() share one state)."""
+    global _parsed_spec
+    spec = _core.flag("FLAGS_fault_inject")
+    if spec == _parsed_spec:
+        return
+    with _lock:
+        if spec == _parsed_spec:
+            return
+        _armed.clear()
+        _hits.clear()
+        _armed.update(_parse_spec(spec))
+        _parsed_spec = spec
+        if _armed:
+            logger.warning("fault injection armed: %s", dict(_armed))
+
+
+def arm(spec):
+    """Programmatically arm fault points (same grammar as the flag)."""
+    global _parsed_spec
+    _core.set_flags({"FLAGS_fault_inject": spec})
+    _parsed_spec = None  # force re-parse: re-arming one spec resets its counts
+    _sync_from_flag()
+
+
+def disarm():
+    """Disarm every fault point and clear hit counters."""
+    arm("")
+
+
+def hits(name):
+    """Times an armed `name` point was reached (fired or already spent)."""
+    return _hits.get(name, 0)
+
+
+def inject(name, context=None):
+    """Fault point: raise InjectedFault if `name` is armed with shots left.
+
+    Call this at the spot where the real failure would surface; the
+    recovery path around it then serves both chaos tests and production.
+    """
+    _sync_from_flag()
+    if not _armed:
+        _registry.setdefault(name, "")
+        return
+    with _lock:
+        remaining = _armed.get(name)
+        _registry.setdefault(name, "")
+        if remaining is None:
+            return
+        _hits[name] = _hits.get(name, 0) + 1
+        if remaining == 0:
+            return
+        if remaining > 0:
+            _armed[name] = remaining - 1
+    logger.warning("fault point %r firing (context=%s)", name, context)
+    raise InjectedFault(name, context)
+
+
+# Built-in fault points wired through the runtime (checkpoint.* are
+# registered by distributed/checkpoint.py next to their sites):
+register("dataloader.next", "fires before the data loader produces each batch")
+register("collective.all_reduce", "fires at the entry of collective.all_reduce")
+register("launch.spawn", "fires when the launch controller spawns a trainer")
+register("supervisor.step", "fires inside Supervisor.after_step")

@@ -1,0 +1,229 @@
+"""Supervised step loop: non-finite watchdog + preemption-to-checkpoint.
+
+``Supervisor`` wraps a training loop (hapi.Model.fit uses one; standalone
+loops construct their own).  It provides three guarantees:
+
+1. **Non-finite watchdog** — ``after_step(loss)`` counts CONSECUTIVE
+   non-finite losses (an AMP scaler's skipped steps count too, via
+   ``attach_scaler``: the scaler's found-inf signal is the same skip-step
+   machinery that guards the optimizer) and raises
+   :class:`NonFiniteLossError` with a diagnostic once the budget is
+   exhausted — a diverged job stops burning accelerator time.
+2. **Preemption handling** — SIGTERM (the pod-preemption signal) sets a
+   flag; at the next step boundary ``maybe_exit()`` writes a best-effort
+   checkpoint and exits with :data:`RESTART_EXIT_CODE` (75, EX_TEMPFAIL),
+   which the launch controller treats as "relaunch me with backoff".
+3. **Crash checkpoint** — the ``guard()`` context manager around a step
+   body turns an unhandled exception into best-effort-checkpoint +
+   re-raise, so the relaunched trainer resumes from the newest state the
+   dying one could persist.
+
+The checkpoint hook is any zero-arg callable (typically
+``lambda: checkpoint.save_checkpoint(state, dir, step)``); failures inside
+it are swallowed — a best-effort save must never mask the original fault.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import math
+import signal as _signal
+import threading
+
+from . import injection as _inj
+
+logger = logging.getLogger("paddle_tpu")
+
+# EX_TEMPFAIL: "temporary failure, retry" — the launcher relaunches
+# (bounded by --max_restarts) instead of counting this as a hard crash.
+RESTART_EXIT_CODE = 75
+
+
+class NonFiniteLossError(FloatingPointError):
+    """Training diverged: too many consecutive non-finite steps."""
+
+
+class RestartRequested(SystemExit):
+    """Raised to exit the trainer with the restart-requested code."""
+
+    def __init__(self, reason=""):
+        self.reason = reason
+        super().__init__(RESTART_EXIT_CODE)
+
+
+def _is_finite(loss):
+    if loss is None:
+        return True
+    try:
+        v = float(loss)
+    except (TypeError, ValueError):
+        import numpy as np
+
+        v = float(np.asarray(loss))
+    return math.isfinite(v)
+
+
+class Supervisor:
+    """Step-loop guard: non-finite watchdog, SIGTERM → checkpoint + exit 75.
+
+    Parameters
+    ----------
+    save_fn : zero-arg callable, optional
+        Best-effort checkpoint hook, called on preemption and on a crash
+        inside ``guard()``.  Exceptions from it are logged, never raised.
+    max_bad_steps : int
+        Consecutive non-finite steps tolerated before
+        :class:`NonFiniteLossError`.  0 disables the watchdog.
+    handle_signals : bool
+        Install SIGTERM (and SIGUSR1, the common preemption warning)
+        handlers.  Only possible from the main thread; silently skipped
+        elsewhere.  ``uninstall()`` (or ``with Supervisor(...)``) restores
+        the previous handlers.
+    """
+
+    def __init__(self, save_fn=None, max_bad_steps=3, handle_signals=True):
+        self.save_fn = save_fn
+        self.max_bad_steps = max_bad_steps
+        self.step = 0
+        self.bad_steps = 0  # consecutive
+        self.total_bad_steps = 0
+        self.preempted = False
+        self._signum = None
+        self._scaler = None
+        self._prev_handlers = {}
+        self._lock = threading.Lock()
+        if handle_signals:
+            self._install()
+
+    # -- signals -----------------------------------------------------------
+    def _install(self):
+        for sig in (_signal.SIGTERM, _signal.SIGUSR1):
+            try:
+                self._prev_handlers[sig] = _signal.signal(sig, self._on_signal)
+            except ValueError:
+                # not the main thread: the loop can still poll .preempted
+                # set by request_stop() from whoever does own the signal
+                self._prev_handlers.clear()
+                return
+
+    def _on_signal(self, signum, frame):
+        self.request_stop(signum)
+
+    def request_stop(self, signum=None):
+        """Mark the job preempted; honored at the next step boundary."""
+        self.preempted = True
+        self._signum = signum
+        logger.warning(
+            "supervisor: stop requested (signal %s) — will checkpoint and "
+            "exit %d at the next step boundary", signum, RESTART_EXIT_CODE,
+        )
+
+    def uninstall(self):
+        for sig, h in self._prev_handlers.items():
+            try:
+                _signal.signal(sig, h)
+            except ValueError:
+                pass
+        self._prev_handlers.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    # -- scaler integration ------------------------------------------------
+    def attach_scaler(self, scaler):
+        """Count the AMP scaler's skipped steps (found inf/nan in grads) as
+        bad steps: the scaler already computes found_inf to guard the
+        optimizer update; ``after_step`` reuses that signal instead of
+        re-scanning gradients."""
+        self._scaler = scaler
+        return scaler
+
+    def _scaler_found_inf(self):
+        s = self._scaler
+        if s is None:
+            return False
+        return bool(getattr(s, "last_found_inf", False))
+
+    # -- step accounting ---------------------------------------------------
+    def after_step(self, loss=None):
+        """Record one finished step.  Raises NonFiniteLossError after
+        `max_bad_steps` CONSECUTIVE non-finite steps; calls maybe_exit()
+        so a pending preemption turns into checkpoint + exit."""
+        _inj.inject("supervisor.step")
+        self.step += 1
+        bad = not _is_finite(loss) or self._scaler_found_inf()
+        if bad:
+            self.bad_steps += 1
+            self.total_bad_steps += 1
+            logger.warning(
+                "supervisor: non-finite step %d (%d consecutive, budget %d)",
+                self.step, self.bad_steps, self.max_bad_steps,
+            )
+            if self.max_bad_steps and self.bad_steps >= self.max_bad_steps:
+                raise NonFiniteLossError(
+                    f"training diverged: {self.bad_steps} consecutive "
+                    f"non-finite steps (step {self.step}, last loss "
+                    f"{loss!r}, {self.total_bad_steps} bad steps total). "
+                    "Lower the learning rate, check the data pipeline, or "
+                    "raise max_bad_steps if spikes are expected."
+                )
+        else:
+            self.bad_steps = 0
+        self.maybe_exit()
+        return not bad
+
+    # -- preemption / crash checkpoint -------------------------------------
+    def _best_effort_save(self, why):
+        if self.save_fn is None:
+            return False
+        try:
+            self.save_fn()
+            logger.warning("supervisor: checkpoint written (%s)", why)
+            return True
+        except Exception as e:  # must not mask the original fault
+            logger.error("supervisor: best-effort checkpoint failed: %s", e)
+            return False
+
+    def maybe_exit(self):
+        """If preemption was requested, checkpoint (best effort) and exit
+        with the restart-requested code."""
+        if not self.preempted:
+            return
+        self._best_effort_save(f"preemption signal {self._signum}")
+        self.uninstall()
+        raise RestartRequested(f"signal {self._signum}")
+
+    @contextlib.contextmanager
+    def guard(self):
+        """Wrap a step body: an unhandled exception checkpoints (best
+        effort) before propagating, so the relaunched trainer resumes from
+        the freshest state this one could persist."""
+        try:
+            yield self
+        except (RestartRequested, KeyboardInterrupt):
+            raise
+        except Exception:
+            self._best_effort_save("crash")
+            raise
+
+
+def run_supervised(step_fn, steps, save_fn=None, max_bad_steps=3, start_step=0):
+    """Drive `step_fn(step) -> loss` for `steps` steps under a Supervisor.
+
+    The minimal standalone harness: non-finite watchdog, preemption →
+    checkpoint + exit 75, crash → best-effort checkpoint + raise.  Returns
+    the list of losses."""
+    losses = []
+    with Supervisor(save_fn=save_fn, max_bad_steps=max_bad_steps) as sup:
+        sup.step = start_step
+        for i in range(start_step, steps):
+            with sup.guard():
+                loss = step_fn(i)
+            losses.append(loss)
+            sup.after_step(loss)
+    return losses

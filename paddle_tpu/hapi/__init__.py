@@ -177,7 +177,16 @@ class Model:
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         return self.network(*inputs)
 
-    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1, eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2, drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1, eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2, drop_last=False, shuffle=True, num_workers=0, callbacks=None, max_bad_steps=10):
+        """Train the model (reference: paddle.Model.fit), under a
+        fault.Supervisor: `max_bad_steps` consecutive non-finite losses
+        abort with a diagnostic (NonFiniteLossError) instead of burning
+        compute on a diverged job, and SIGTERM/preemption checkpoints
+        best-effort (to `save_dir/preempt` when save_dir is set) and exits
+        with the restart-requested code the launch controller honors.
+        Pass max_bad_steps=0 to disable the watchdog."""
+        from ..fault import Supervisor
+
         loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
             train_data, batch_size=batch_size, shuffle=shuffle, drop_last=drop_last, num_workers=num_workers
         )
@@ -187,29 +196,41 @@ class Model:
         if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbs):
             cbs.append(ModelCheckpoint(save_freq, save_dir))
         cblist = _CallbackList(cbs, self)
+
+        save_fn = None
+        if save_dir:
+            def save_fn():
+                import os
+
+                os.makedirs(save_dir, exist_ok=True)
+                self.save(os.path.join(save_dir, "preempt"))
+
         cblist.call("on_train_begin")
         history = []
-        for epoch in range(epochs):
-            cblist.call("on_epoch_begin", epoch)
-            for m in self._metrics:
-                m.reset()
-            losses = []
-            for step, batch in enumerate(loader):
-                cblist.call("on_train_batch_begin", step)
-                x, y = batch[0], batch[1]
-                loss = self.train_batch(x, y)[0]
-                losses.append(loss)
-                logs = {"loss": loss, **getattr(self, "_last_metrics", {})}
-                cblist.call("on_train_batch_end", step, logs)
-            epoch_logs = {"loss": float(np.mean(losses)), **getattr(self, "_last_metrics", {})}
-            history.append(epoch_logs["loss"])
-            cblist.call("on_epoch_end", epoch, epoch_logs)
-            if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                cblist.call("on_eval_begin")
-                result = self.evaluate(eval_data, batch_size=batch_size, verbose=verbose)
-                cblist.call("on_eval_end", result)
-            if cblist.stop_training:
-                break
+        with Supervisor(save_fn=save_fn, max_bad_steps=max_bad_steps) as sup:
+            for epoch in range(epochs):
+                cblist.call("on_epoch_begin", epoch)
+                for m in self._metrics:
+                    m.reset()
+                losses = []
+                for step, batch in enumerate(loader):
+                    cblist.call("on_train_batch_begin", step)
+                    x, y = batch[0], batch[1]
+                    with sup.guard():
+                        loss = self.train_batch(x, y)[0]
+                    losses.append(loss)
+                    logs = {"loss": loss, **getattr(self, "_last_metrics", {})}
+                    cblist.call("on_train_batch_end", step, logs)
+                    sup.after_step(loss)
+                epoch_logs = {"loss": float(np.mean(losses)), **getattr(self, "_last_metrics", {})}
+                history.append(epoch_logs["loss"])
+                cblist.call("on_epoch_end", epoch, epoch_logs)
+                if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                    cblist.call("on_eval_begin")
+                    result = self.evaluate(eval_data, batch_size=batch_size, verbose=verbose)
+                    cblist.call("on_eval_end", result)
+                if cblist.stop_training:
+                    break
         cblist.call("on_train_end")
         return history
 

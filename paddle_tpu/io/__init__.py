@@ -437,17 +437,22 @@ class DataLoader:
         return len(self.batch_sampler)
 
     def _iter_batches(self):
+        from ..fault import injection as _inj
+
         if self._iterable_mode:
             batch = []
             for sample in self.dataset:
                 batch.append(sample)
                 if len(batch) == self.batch_size:
+                    _inj.inject("dataloader.next")
                     yield self.collate_fn(batch)
                     batch = []
             if batch and not self.drop_last:
+                _inj.inject("dataloader.next")
                 yield self.collate_fn(batch)
         else:
             for idx_batch in self.batch_sampler:
+                _inj.inject("dataloader.next")
                 samples = [self.dataset[i] for i in idx_batch]
                 yield self.collate_fn(samples)
 

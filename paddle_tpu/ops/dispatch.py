@@ -209,6 +209,11 @@ class _CaptureRecorder:
     def __init__(self):
         self.inputs = []
         self.created = set()
+        # id(tensor) -> payload when FIRST seen: the discovery pass diffs
+        # these afterwards to enforce the purity contract (a branch that
+        # writes to pre-existing state would otherwise leave abstract
+        # values in live tensors)
+        self.snapshots = {}
 
     def captured(self):
         out, seen = [], set()
@@ -251,6 +256,8 @@ def apply(fn, inputs, name=None, multi=False, outputs_stop_gradient=None):
 
     if _capture_recorder is not None:
         _capture_recorder.inputs.extend(inputs)
+        for t in inputs:
+            _capture_recorder.snapshots.setdefault(id(t), t._data)
 
     if not record:
         if ckey is not _UNHASHABLE:

@@ -234,10 +234,19 @@ class Optimizer:
         return sd
 
     def set_state_dict(self, state, strict=True):
-        """Restore accumulator state.  `strict=True` (default) raises on
-        entries that match no parameter — renamed/re-indexed params must not
-        silently lose optimizer state (SURVEY §5.4 resume contract); pass
-        strict=False for the old warn-and-ignore behavior."""
+        """Restore accumulator state (reference: optimizer.set_state_dict).
+
+        `strict=True` (default) raises on state entries that match no
+        current parameter, naming the unmatched keys — renamed or
+        re-indexed params must not silently lose optimizer state (SURVEY
+        §5.4 resume contract).
+
+        Pass `strict=False` for PARTIAL resume: the unmatched entries are
+        warned about and ignored.  This is the right mode when the model
+        intentionally diverged from the checkpoint — e.g. resuming a
+        frozen/fine-tune run where some checkpointed params are no longer
+        trainable, or loading a subset of a larger model's optimizer
+        state.  Matched entries restore normally either way."""
         import warnings
 
         self._step_count = state.get("_step_count", 0)
@@ -283,12 +292,20 @@ class Optimizer:
                 _core.unmark_born(t)
                 self._accumulators[key] = t
         if unmatched:
+            shown = ", ".join(repr(k) for k in unmatched[:10])
+            more = f" (+{len(unmatched) - 10} more)" if len(unmatched) > 10 else ""
             msg = (
-                f"optimizer.set_state_dict: {len(unmatched)} state entries did "
-                f"not match any parameter name: {unmatched[:5]}"
+                f"optimizer.set_state_dict: {len(unmatched)} state entr"
+                f"{'y' if len(unmatched) == 1 else 'ies'} did not match any "
+                f"current parameter name: {shown}{more}. This optimizer "
+                f"tracks {len(by_key)} parameter(s); renamed or re-indexed "
+                "parameters lose their optimizer state unless the keys line up."
             )
             if strict:
-                raise ValueError(msg + " (pass strict=False to ignore)")
+                raise ValueError(
+                    msg + " Pass strict=False to ignore unmatched entries "
+                    "(partial resume, e.g. a frozen/fine-tuned model)."
+                )
             warnings.warn(msg + " — ignored (strict=False)")
 
 

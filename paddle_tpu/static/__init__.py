@@ -206,6 +206,28 @@ def _record_captures(run):
 
     jax.eval_shape(wrapped)
     captured = rec.captured()
+    # Purity contract: the discovery pass ran the block for real at the
+    # paddle level, so a block that WRITES to pre-existing state (in-place
+    # ops, buffer updates like BatchNorm running stats) has just rebound
+    # live tensors to abstract eval_shape values — silent state corruption
+    # that surfaces as a baffling tracer error much later.  Diff every
+    # pre-existing tensor the block touched against its first-seen payload:
+    # restore the original and raise a clear error instead.
+    impure = []
+    for t in captured:
+        snap = rec.snapshots.get(id(t))
+        if snap is not None and t._data is not snap:
+            t._data = snap  # undo the corruption before raising
+            impure.append(getattr(t, "name", None) or f"<{tuple(t.shape)} {t.dtype}>")
+    if impure:
+        raise ValueError(
+            "static control-flow block is impure: it wrote to pre-existing "
+            f"tensor(s) {impure[:5]} during the discovery pass. cond/while_loop "
+            "branches must be side-effect-free — return new values through "
+            "the block's outputs (loop_vars / branch returns) instead of "
+            "assigning to captured state (e.g. put BatchNorm layers in eval "
+            "mode inside branches). The original payloads were restored."
+        )
     # a block may return a pre-existing tensor DIRECTLY (no op touches it,
     # so apply() never records it) — it still needs to be an operand or its
     # gradient is silently lost

@@ -1,0 +1,565 @@
+"""Chaos tests for the paddle_tpu.fault subsystem (ISSUE PR 1: robustness).
+
+Every recovery path is driven by the SAME fault-injection registry that
+production flags expose (FLAGS_fault_inject="name[:count|*],..."):
+
+* save failure -> bounded retry succeeds, checkpoint commits
+* torn checkpoint (crash between data write and COMMIT) -> auto-resume
+  skips it and loads the latest VALID checkpoint
+* corrupted payload -> checksum verification rejects it, resume falls back
+* SIGTERM mid-step -> graceful best-effort checkpoint + exit 75
+  (EX_TEMPFAIL, the launcher's "relaunch me" code)
+* N consecutive non-finite losses -> supervisor aborts with a diagnostic
+* launch controller: exponential backoff restarts bounded by --max_restarts,
+  restart-requested trainers get PADDLE_CKPT_DIR / PADDLE_RESTART_NUM
+
+Launcher subprocess tests reuse the tiny-pure-python-trainer pattern from
+test_launch.py; the multi-process restart-resume test is @pytest.mark.slow.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fault
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.fault import injection as _inj
+
+LAUNCH = [sys.executable, "-m", "paddle_tpu.distributed.launch"]
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    """No chaos leaks: every test ends with the registry disarmed."""
+    yield
+    fault.disarm()
+
+
+def _env():
+    e = dict(os.environ)
+    e["PYTHONPATH"] = REPO + os.pathsep + e.get("PYTHONPATH", "")
+    e.pop("PALLAS_AXON_POOL_IPS", None)
+    return e
+
+
+def _state(val=1.0):
+    return {"w": paddle.to_tensor(np.full((4,), val, np.float32)),
+            "b": paddle.to_tensor(np.arange(3, dtype=np.float32))}
+
+
+# ---------------------------------------------------------------- injection
+
+class TestInjection:
+    def test_spec_grammar_counts(self):
+        fault.arm("supervisor.step:2")
+        with pytest.raises(fault.InjectedFault):
+            _inj.inject("supervisor.step")
+        with pytest.raises(fault.InjectedFault):
+            _inj.inject("supervisor.step")
+        _inj.inject("supervisor.step")  # shots spent: passes through
+        assert fault.hits("supervisor.step") == 3
+
+    def test_always_and_disarm(self):
+        fault.arm("dataloader.next:*")
+        for _ in range(3):
+            with pytest.raises(fault.InjectedFault):
+                _inj.inject("dataloader.next")
+        fault.disarm()
+        _inj.inject("dataloader.next")
+        assert fault.hits("dataloader.next") == 0  # disarm clears counters
+
+    def test_flag_arming_via_set_flags(self):
+        # the production arming surface: plain paddle.set_flags / env
+        paddle.set_flags({"FLAGS_fault_inject": "collective.all_reduce"})
+        try:
+            with pytest.raises(fault.InjectedFault):
+                _inj.inject("collective.all_reduce")
+            _inj.inject("collective.all_reduce")  # one-shot default
+        finally:
+            paddle.set_flags({"FLAGS_fault_inject": ""})
+
+    def test_rearm_resets_counters(self):
+        fault.arm("supervisor.step:1")
+        with pytest.raises(fault.InjectedFault):
+            _inj.inject("supervisor.step")
+        fault.arm("supervisor.step:1")  # same spec re-armed -> fresh shot
+        with pytest.raises(fault.InjectedFault):
+            _inj.inject("supervisor.step")
+
+    def test_bad_spec_rejected(self):
+        with pytest.raises(ValueError, match="count"):
+            fault.arm("checkpoint.save:often")
+        fault.disarm()
+
+    def test_builtin_points_registered(self):
+        pts = fault.fault_points()
+        for name in ("dataloader.next", "collective.all_reduce",
+                     "launch.spawn", "supervisor.step", "checkpoint.save",
+                     "checkpoint.commit", "checkpoint.load"):
+            assert name in pts, f"fault point {name} not registered"
+
+    def test_dataloader_fault_point_wired(self):
+        ds = [(np.zeros((2,), np.float32),) for _ in range(4)]
+        loader = paddle.io.DataLoader(ds, batch_size=2)
+        fault.arm("dataloader.next")
+        with pytest.raises(fault.InjectedFault):
+            list(loader)
+        fault.disarm()
+        assert len(list(loader)) == 2  # recovered once disarmed
+
+    def test_collective_fault_point_wired(self):
+        from paddle_tpu.distributed import collective
+        t = paddle.to_tensor(np.ones((2,), np.float32))
+        fault.arm("collective.all_reduce")
+        with pytest.raises(fault.InjectedFault):
+            collective.all_reduce(t)
+        fault.disarm()
+        collective.all_reduce(t)
+
+
+# -------------------------------------------------------------- checkpoints
+
+class TestHardenedCheckpoint:
+    def test_atomic_commit_and_roundtrip(self, tmp_path):
+        sd = _state(3.0)
+        path = ckpt.save_checkpoint(sd, str(tmp_path), step=1)
+        assert os.path.basename(path) == "step_1"
+        assert os.path.exists(os.path.join(path, ckpt.COMMIT_FILE))
+        man = ckpt.read_commit_manifest(path)
+        assert man["step"] == 1 and "w" in man["arrays"]
+        dst = _state(0.0)
+        assert ckpt.load_latest(dst, str(tmp_path)) == 1
+        np.testing.assert_allclose(dst["w"].numpy(), np.full((4,), 3.0))
+
+    def test_save_failure_retries_then_succeeds(self, tmp_path):
+        fault.arm("checkpoint.save:2")  # first two attempts fail
+        path = ckpt.save_checkpoint(_state(), str(tmp_path), step=5,
+                                    retries=3, backoff=0.01)
+        assert fault.hits("checkpoint.save") == 3  # 2 faults + 1 success
+        assert ckpt.find_latest_valid(str(tmp_path)) == (5, path)
+
+    def test_save_retries_exhausted_raises(self, tmp_path):
+        fault.arm("checkpoint.save:*")
+        with pytest.raises(RuntimeError, match="failed after"):
+            ckpt.save_checkpoint(_state(), str(tmp_path), step=5,
+                                 retries=2, backoff=0.01)
+        fault.disarm()
+        assert ckpt.find_latest_valid(str(tmp_path)) is None
+        # no stray committed dirs; only .tmp debris at worst
+        for d in os.listdir(tmp_path):
+            assert not ckpt._STEP_RE.match(d)
+
+    def test_torn_checkpoint_skipped_on_resume(self, tmp_path):
+        root = str(tmp_path)
+        ckpt.save_checkpoint(_state(1.0), root, step=1)
+        # crash between data write and COMMIT: data durable, marker absent
+        fault.arm("checkpoint.commit")
+        with pytest.raises(fault.InjectedFault):
+            ckpt.save_checkpoint(_state(2.0), root, step=2, retries=0)
+        fault.disarm()
+        assert os.path.isdir(os.path.join(root, "step_2.tmp"))
+        assert ckpt.find_latest_valid(root)[0] == 1
+        dst = _state(0.0)
+        assert ckpt.load_latest(dst, root) == 1
+        np.testing.assert_allclose(dst["w"].numpy(), np.full((4,), 1.0))
+        # the torn step can be re-saved cleanly over its debris
+        ckpt.save_checkpoint(_state(2.0), root, step=2)
+        assert ckpt.find_latest_valid(root)[0] == 2
+
+    def test_corrupt_payload_falls_back_to_older(self, tmp_path):
+        root = str(tmp_path)
+        ckpt.save_checkpoint(_state(1.0), root, step=1)
+        p2 = ckpt.save_checkpoint(_state(2.0), root, step=2)
+        # flip bytes in step_2's payload without touching its manifest
+        corrupted = False
+        for dirpath, _, files in os.walk(p2):
+            for fn in files:
+                if fn == ckpt.COMMIT_FILE:
+                    continue
+                fp = os.path.join(dirpath, fn)
+                if os.path.getsize(fp) > 64:
+                    with open(fp, "r+b") as f:
+                        f.seek(-32, os.SEEK_END)
+                        f.write(b"\xde\xad\xbe\xef" * 8)
+                    corrupted = True
+        assert corrupted, "found no payload file to corrupt"
+        dst = _state(0.0)
+        step = ckpt.load_latest(dst, root)
+        assert step == 1, "resume must fall back past the corrupt checkpoint"
+        np.testing.assert_allclose(dst["w"].numpy(), np.full((4,), 1.0))
+
+    def test_retention_keeps_last_n_and_prunes_tmp(self, tmp_path):
+        root = str(tmp_path)
+        for s in range(1, 5):
+            ckpt.save_checkpoint(_state(float(s)), root, step=s, keep_last_n=2)
+        steps = sorted(s for s, _ in ckpt._committed_steps(root))
+        assert steps == [3, 4]
+        # stale torn debris from an OLD step is swept by the next commit
+        os.makedirs(os.path.join(root, "step_1.tmp"), exist_ok=True)
+        ckpt.save_checkpoint(_state(5.0), root, step=5, keep_last_n=2)
+        assert not os.path.exists(os.path.join(root, "step_1.tmp"))
+
+    def test_load_latest_env_root(self, tmp_path, monkeypatch):
+        root = str(tmp_path)
+        ckpt.save_checkpoint(_state(7.0), root, step=3)
+        monkeypatch.setenv("PADDLE_CKPT_DIR", root)
+        dst = _state(0.0)
+        assert ckpt.load_latest(dst) == 3  # root from the launcher env
+        np.testing.assert_allclose(dst["w"].numpy(), np.full((4,), 7.0))
+
+    def test_load_latest_empty_root_returns_none(self, tmp_path):
+        assert ckpt.load_latest(_state(), str(tmp_path)) is None
+
+    def test_verify_checkpoint_detects_mismatch(self, tmp_path):
+        root = str(tmp_path)
+        path = ckpt.save_checkpoint(_state(1.0), root, step=1)
+        good = _state(1.0)
+        ckpt.load_state_dict(good, path)
+        ckpt.verify_checkpoint(good, path)  # matches: no raise
+        bad = {"w": paddle.to_tensor(np.full((4,), 9.0, np.float32)),
+               "b": good["b"]}
+        with pytest.raises(ckpt.CheckpointCorruption):
+            ckpt.verify_checkpoint(bad, path)
+
+
+# --------------------------------------------------------------- supervisor
+
+class TestSupervisor:
+    def test_nan_watchdog_aborts_with_diagnostic(self):
+        with fault.Supervisor(max_bad_steps=3, handle_signals=False) as sup:
+            sup.after_step(1.0)
+            sup.after_step(float("nan"))
+            sup.after_step(float("inf"))
+            with pytest.raises(fault.NonFiniteLossError,
+                               match="3 consecutive"):
+                sup.after_step(float("nan"))
+
+    def test_finite_step_resets_consecutive_count(self):
+        with fault.Supervisor(max_bad_steps=2, handle_signals=False) as sup:
+            for _ in range(5):  # never two in a row
+                sup.after_step(float("nan"))
+                sup.after_step(0.5)
+            assert sup.total_bad_steps == 5 and sup.bad_steps == 0
+
+    def test_scaler_skip_steps_count_as_bad(self):
+        """The AMP scaler's found-inf signal (its skip-step machinery) feeds
+        the watchdog even when the reported loss itself is finite."""
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+        w = paddle.to_tensor(np.ones((2,), np.float32), stop_gradient=False)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        with fault.Supervisor(max_bad_steps=2, handle_signals=False) as sup:
+            sup.attach_scaler(scaler)
+            for i in range(2):
+                bad = paddle.to_tensor(np.array([np.inf, 1.0], np.float32))
+                loss = (w * bad).sum()
+                scaled = scaler.scale(loss)
+                scaled.backward()
+                scaler.step(opt)   # skipped: grads contain inf
+                scaler.update()
+                assert scaler.last_found_inf
+                opt.clear_grad()
+                if i < 1:
+                    sup.after_step(1.0)  # finite loss, but scaler skipped
+                else:
+                    with pytest.raises(fault.NonFiniteLossError):
+                        sup.after_step(1.0)
+
+    def test_guard_checkpoints_on_crash(self, tmp_path):
+        saved = []
+        sup = fault.Supervisor(save_fn=lambda: saved.append(sup.step),
+                               handle_signals=False)
+        with pytest.raises(ZeroDivisionError):
+            with sup.guard():
+                1 / 0
+        assert saved == [0], "crash inside guard() must best-effort save"
+
+    def test_save_fn_failure_never_masks_the_crash(self):
+        def bad_save():
+            raise IOError("disk full")
+        sup = fault.Supervisor(save_fn=bad_save, handle_signals=False)
+        with pytest.raises(ZeroDivisionError):  # NOT IOError
+            with sup.guard():
+                1 / 0
+
+    def test_request_stop_checkpoints_and_exits_75(self, tmp_path):
+        saved = []
+        sup = fault.Supervisor(save_fn=lambda: saved.append(True),
+                               handle_signals=False)
+        sup.after_step(1.0)
+        sup.request_stop(signal.SIGTERM)
+        with pytest.raises(fault.RestartRequested) as ei:
+            sup.after_step(1.0)
+        assert ei.value.code == fault.RESTART_EXIT_CODE == 75
+        assert saved == [True]
+
+    def test_run_supervised_diverged(self):
+        with pytest.raises(fault.NonFiniteLossError):
+            fault.run_supervised(lambda i: float("nan"), steps=10,
+                                 max_bad_steps=2)
+
+    def test_injected_step_fault_triggers_guard_save(self, tmp_path):
+        """FLAGS_fault_inject chaos on the supervisor's own step boundary."""
+        saved = []
+        sup = fault.Supervisor(save_fn=lambda: saved.append(True),
+                               handle_signals=False)
+        fault.arm("supervisor.step")
+        with pytest.raises(fault.InjectedFault):
+            with sup.guard():
+                sup.after_step(1.0)
+        assert saved == [True]
+
+
+# -------------------------------------------------- model fit + end-to-end
+
+class TestTrainingIntegration:
+    def _model(self):
+        from paddle_tpu import nn
+        net = nn.Linear(4, 2)
+        model = paddle.Model(net)
+        opt = paddle.optimizer.SGD(learning_rate=1e30,  # forces divergence
+                                   parameters=net.parameters())
+        model.prepare(opt, paddle.nn.MSELoss())
+        return model
+
+    def test_fit_aborts_on_diverged_training(self):
+        model = self._model()
+        data = [(np.random.rand(4).astype(np.float32) * 1e6,
+                 np.zeros((2,), np.float32)) for _ in range(32)]
+        with pytest.raises(fault.NonFiniteLossError, match="diverged"):
+            model.fit(data, batch_size=4, epochs=4, verbose=0,
+                      max_bad_steps=3)
+
+    def test_chaos_resume_cycle(self, tmp_path):
+        """The acceptance story: train with per-step checkpoints, inject a
+        save failure (retried through) then a torn commit (crash), and
+        resume from the latest VALID checkpoint."""
+        root = str(tmp_path)
+        from paddle_tpu import nn
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .rand(8, 4).astype(np.float32))
+
+        def step():
+            loss = (net(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return float(loss.numpy())
+
+        sd = {"net": net.state_dict(), "opt": opt.state_dict()}
+        step(); ckpt.save_checkpoint(sd, root, step=1)
+        # step 2: transient storage blip — retry commits anyway
+        fault.arm("checkpoint.save:1")
+        step(); ckpt.save_checkpoint(sd, root, step=2, backoff=0.01)
+        w_step2 = net.weight.numpy().copy()
+        # step 3: hard crash between data write and COMMIT (torn)
+        fault.arm("checkpoint.commit")
+        step()
+        with pytest.raises(fault.InjectedFault):
+            ckpt.save_checkpoint(sd, root, step=3, retries=0)
+        fault.disarm()
+
+        # "relaunched" trainer: fresh model resumes from latest VALID
+        paddle.seed(123)  # different init — resume must overwrite it
+        net2 = nn.Linear(4, 2)
+        opt2 = paddle.optimizer.SGD(learning_rate=0.1,
+                                    parameters=net2.parameters())
+        sd2 = {"net": net2.state_dict(), "opt": opt2.state_dict()}
+        resumed = ckpt.load_latest(sd2, root)
+        assert resumed == 2, "must skip the torn step_3 checkpoint"
+        net2.set_state_dict(sd2["net"])
+        opt2.set_state_dict(sd2["opt"])
+        np.testing.assert_allclose(net2.weight.numpy(), w_step2, rtol=1e-6)
+
+    def test_sigterm_mid_step_graceful_checkpoint_exit75(self, tmp_path):
+        """SIGTERM a live supervised trainer: it must commit a best-effort
+        checkpoint and exit with the restart-requested code (75)."""
+        root = tmp_path / "ckpt"
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import os, sys, time\n"
+            "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+            "os.environ.pop('PALLAS_AXON_POOL_IPS', None)\n"
+            f"sys.path.insert(0, {REPO!r})\n"
+            "import numpy as np\n"
+            "import paddle_tpu as paddle\n"
+            "from paddle_tpu import fault\n"
+            "from paddle_tpu.distributed import checkpoint as ckpt\n"
+            f"root = {str(root)!r}\n"
+            "sd = {'w': paddle.to_tensor(np.ones(4, np.float32))}\n"
+            "sup = fault.Supervisor(max_bad_steps=0)\n"
+            "sup.save_fn = lambda: ckpt.save_checkpoint(sd, root, sup.step)\n"
+            f"open({str(tmp_path / 'ready')!r}, 'w').write('1')\n"
+            "for _ in range(100000):\n"
+            "    time.sleep(0.02)\n"
+            "    sup.after_step(0.5)\n"
+        )
+        proc = subprocess.Popen([sys.executable, str(script)], env=_env(),
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+        deadline = time.time() + 120
+        while not (tmp_path / "ready").exists():
+            assert time.time() < deadline, "trainer never came up"
+            assert proc.poll() is None, proc.stdout.read()
+            time.sleep(0.1)
+        time.sleep(0.3)  # let it take a few steps
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+        out = proc.stdout.read()
+        assert rc == fault.RESTART_EXIT_CODE, (rc, out)
+        latest = ckpt.find_latest_valid(str(root))
+        assert latest is not None, f"no checkpoint committed: {out}"
+        dst = {"w": paddle.to_tensor(np.zeros(4, np.float32))}
+        assert ckpt.load_latest(dst, str(root)) == latest[0]
+        np.testing.assert_allclose(dst["w"].numpy(), np.ones(4))
+
+
+# -------------------------------------------------------- launch supervisor
+
+class TestLaunchRestarts:
+    def test_restart_budget_with_backoff(self, tmp_path):
+        """An always-crashing trainer is relaunched with exponential backoff
+        and given up after --max_restarts; lives = 1 + budget."""
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import os, sys\n"
+            "open(os.environ['OUT_DIR'] + '/lives', 'a').write('x')\n"
+            "sys.exit(3)\n"
+        )
+        env = _env()
+        env["OUT_DIR"] = str(tmp_path)
+        t0 = time.time()
+        r = subprocess.run(
+            LAUNCH + ["--log_dir", str(tmp_path / "log"),
+                      "--max_restarts", "2", "--restart_backoff", "0.2",
+                      str(script)],
+            env=env, cwd=REPO, timeout=120,
+            capture_output=True, text=True,
+        )
+        elapsed = time.time() - t0
+        assert r.returncode != 0
+        assert (tmp_path / "lives").read_text() == "xxx", "1 run + 2 restarts"
+        # exponential backoff floor: 0.2 + 0.4 between the three lives
+        assert elapsed >= 0.6, f"no backoff observed ({elapsed:.2f}s)"
+
+    def test_restart_requested_gets_resume_env(self, tmp_path):
+        """Exit 75 (preemption drain) relaunches the trainer with the
+        checkpoint root + incarnation number in the env contract."""
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import json, os, sys\n"
+            "life = os.environ.get('PADDLE_RESTART_NUM', '')\n"
+            "rec = {'ckpt': os.environ.get('PADDLE_CKPT_DIR'), 'life': life}\n"
+            "open(os.environ['OUT_DIR'] + '/life.' + life, 'w')"
+            ".write(json.dumps(rec))\n"
+            "if life == '0':\n"
+            "    sys.exit(75)  # restart requested (preemption drain)\n"
+        )
+        env = _env()
+        env["OUT_DIR"] = str(tmp_path)
+        r = subprocess.run(
+            LAUNCH + ["--log_dir", str(tmp_path / "log"),
+                      "--max_restarts", "2", "--restart_backoff", "0.05",
+                      "--ckpt_dir", str(tmp_path / "ckpt"), str(script)],
+            env=env, cwd=REPO, timeout=120,
+            capture_output=True, text=True,
+        )
+        assert r.returncode == 0, r.stderr
+        first = json.loads((tmp_path / "life.0").read_text())
+        second = json.loads((tmp_path / "life.1").read_text())
+        assert first["ckpt"] == second["ckpt"] == str(tmp_path / "ckpt")
+        assert (first["life"], second["life"]) == ("0", "1")
+
+    def test_spawn_fault_injection_recovers(self, tmp_path):
+        """Arming launch.spawn via the env flag crashes the first spawn
+        inside the controller; the restart budget absorbs it."""
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import os\n"
+            "open(os.environ['OUT_DIR'] + '/ran', 'a').write('x')\n"
+        )
+        env = _env()
+        env["OUT_DIR"] = str(tmp_path)
+        env["FLAGS_fault_inject"] = "launch.spawn:1"
+        r = subprocess.run(
+            LAUNCH + ["--log_dir", str(tmp_path / "log"),
+                      "--max_restarts", "2", "--restart_backoff", "0.05",
+                      str(script)],
+            env=env, cwd=REPO, timeout=120,
+            capture_output=True, text=True,
+        )
+        assert r.returncode == 0, r.stderr
+        assert (tmp_path / "ran").read_text() == "x"
+
+    @pytest.mark.slow
+    def test_full_restart_resume_training(self, tmp_path):
+        """Multi-process restart e2e: life 0 trains, checkpoints, and exits
+        75 mid-run; the relaunched life resumes from the committed
+        checkpoint via $PADDLE_CKPT_DIR and finishes all steps."""
+        root = tmp_path / "ckpt"
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import os, sys\n"
+            "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+            "os.environ.pop('PALLAS_AXON_POOL_IPS', None)\n"
+            f"sys.path.insert(0, {REPO!r})\n"
+            "import numpy as np\n"
+            "import paddle_tpu as paddle\n"
+            "from paddle_tpu import fault, nn\n"
+            "from paddle_tpu.distributed import checkpoint as ckpt\n"
+            "life = int(os.environ.get('PADDLE_RESTART_NUM', '0'))\n"
+            "root = os.environ['PADDLE_CKPT_DIR']\n"
+            "paddle.seed(0)\n"
+            "net = nn.Linear(4, 2)\n"
+            "opt = paddle.optimizer.SGD(learning_rate=0.1,"
+            " parameters=net.parameters())\n"
+            "sd = {'net': net.state_dict(), 'opt': opt.state_dict()}\n"
+            "start = ckpt.load_latest(sd, root) or 0\n"
+            "if start:\n"
+            "    net.set_state_dict(sd['net'])\n"
+            "    opt.set_state_dict(sd['opt'])\n"
+            "assert (start == 0) == (life == 0), (start, life)\n"
+            "x = paddle.to_tensor(np.random.RandomState(0)"
+            ".rand(8, 4).astype(np.float32))\n"
+            "sup = fault.Supervisor(max_bad_steps=3)\n"
+            "sup.step = start\n"
+            "for step in range(start, 6):\n"
+            "    with sup.guard():\n"
+            "        loss = (net(x) ** 2).mean()\n"
+            "        loss.backward(); opt.step(); opt.clear_grad()\n"
+            "    sup.after_step(float(loss.numpy()))\n"
+            "    sd = {'net': net.state_dict(), 'opt': opt.state_dict()}\n"
+            "    ckpt.save_checkpoint(sd, root, step + 1, keep_last_n=3)\n"
+            "    if step == 2 and life == 0:\n"
+            "        sup.request_stop()  # simulated preemption notice\n"
+            "        sup.maybe_exit()\n"
+            "out = os.environ['OUT_DIR']\n"
+            "open(f'{out}/done.{life}', 'w')"
+            ".write(repr(net.weight.numpy().tolist()))\n"
+        )
+        env = _env()
+        env["OUT_DIR"] = str(tmp_path)
+        env["JAX_PLATFORMS"] = "cpu"
+        r = subprocess.run(
+            LAUNCH + ["--log_dir", str(tmp_path / "log"),
+                      "--max_restarts", "2", "--restart_backoff", "0.1",
+                      "--ckpt_dir", str(root), str(script)],
+            env=env, cwd=REPO, timeout=300,
+            capture_output=True, text=True,
+        )
+        assert r.returncode == 0, r.stderr
+        assert (tmp_path / "done.1").exists(), "second life never finished"
+        assert not (tmp_path / "done.0").exists(), "life 0 should have exited"
+        latest = ckpt.find_latest_valid(str(root))
+        assert latest is not None and latest[0] == 6

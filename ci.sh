@@ -2,14 +2,29 @@
 # Single build+test entry (reference: paddle/scripts/paddle_build.sh —
 # SURVEY.md §2.4 "CI entry").  Builds the native core, runs its gtest,
 # then the full Python suite on the 8-device CPU-sim mesh, and finally a
-# CPU smoke of the benchmark matrix.  Usage: ./ci.sh [fast]
+# CPU smoke of the benchmark matrix.  Usage: ./ci.sh [fast|chaos]
+#   fast  — skip slow tests, stop at first failure
+#   chaos — ONLY the slow-marked fault-domain drills (gang restart,
+#           heartbeat eviction, full restart-resume), each run under a
+#           hard external timeout so a broken watchdog cannot wedge CI
 set -euo pipefail
 cd "$(dirname "$0")"
 
 MODE="${1:-}"
-if [ -n "$MODE" ] && [ "$MODE" != "fast" ]; then
-  echo "usage: ./ci.sh [fast]" >&2
+if [ -n "$MODE" ] && [ "$MODE" != "fast" ] && [ "$MODE" != "chaos" ]; then
+  echo "usage: ./ci.sh [fast|chaos]" >&2
   exit 2
+fi
+
+if [ "$MODE" = "chaos" ]; then
+  echo "== chaos suite (slow fault-domain drills, hard 20min cap) =="
+  # the drills themselves assert the in-process watchdog fires; the
+  # timeout(1) wrapper is the belt-and-braces layer above it
+  timeout -k 30 1200 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python -m pytest tests/test_fault_tolerance.py -q -m slow \
+      -p no:cacheprovider
+  echo "CHAOS OK"
+  exit 0
 fi
 
 echo "== native build =="

@@ -29,6 +29,7 @@ import numpy as np
 import jax
 
 from ..fault import injection as _inj
+from ..fault import watchdog as _wd
 from ..framework import core as _core
 from ..tensor import Tensor
 
@@ -211,6 +212,12 @@ load_state_dict.last_restore_mode = None
 COMMIT_FILE = "COMMIT"
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
+# COMMIT manifest schema version.  v1 (PR 1) had no version field and no
+# data-pipeline state; readers treat a version-less manifest as v1.  v2 adds
+# ``format_version`` and optional ``data_state`` (DataLoader.state_dict()),
+# the exactly-once resume position.
+MANIFEST_VERSION = 2
+
 
 class CheckpointCorruption(RuntimeError):
     """A committed checkpoint failed validation (torn write, bit rot)."""
@@ -249,8 +256,14 @@ def step_dir(root, step):
     return os.path.join(root, f"step_{int(step)}")
 
 
-def save_checkpoint(state_dict, root, step, keep_last_n=None, retries=None, backoff=None):
+def save_checkpoint(state_dict, root, step, keep_last_n=None, retries=None, backoff=None,
+                    data_loader=None):
     """Atomically commit `state_dict` as `root/step_<step>`.
+
+    `data_loader` (anything with ``state_dict()``, typically
+    ``paddle.io.DataLoader``) adds the data-pipeline position to the COMMIT
+    manifest so `load_latest(..., data_loader=...)` resumes on the exact
+    next batch — no replay, no skip.
 
     Save failures (orbax errors, injected faults) are retried with
     exponential backoff (`FLAGS_checkpoint_save_retries` /
@@ -274,7 +287,10 @@ def save_checkpoint(state_dict, root, step, keep_last_n=None, retries=None, back
         try:
             if os.path.isdir(tmp):
                 shutil.rmtree(tmp)  # debris from a previous torn attempt
-            save_state_dict(state_dict, tmp)
+            # a wedged filesystem/orbax write must not stall the gang: the
+            # watchdog turns it into stack dump + exit 75 -> gang restart
+            with _wd.arm("checkpoint.save", context=tmp):
+                save_state_dict(state_dict, tmp)
             break
         except Exception as e:
             attempt += 1
@@ -291,7 +307,14 @@ def save_checkpoint(state_dict, root, step, keep_last_n=None, retries=None, back
             time.sleep(delay)
 
     flat = _flatten_sd(state_dict)
-    manifest = {"step": int(step), "time": time.time(), "arrays": {}}
+    manifest = {
+        "format_version": MANIFEST_VERSION,
+        "step": int(step),
+        "time": time.time(),
+        "arrays": {},
+    }
+    if data_loader is not None and hasattr(data_loader, "state_dict"):
+        manifest["data_state"] = data_loader.state_dict()
     for k, v in flat.items():
         arr = v._raw if isinstance(v, Tensor) else np.asarray(v)
         manifest["arrays"][k] = {
@@ -362,6 +385,16 @@ def read_commit_manifest(path):
             manifest = json.load(f)
     except (OSError, ValueError):
         return None
+    if not isinstance(manifest, dict):
+        return None
+    # PR-1 manifests predate the version field: they are v1 by definition
+    manifest.setdefault("format_version", 1)
+    if int(manifest["format_version"]) > MANIFEST_VERSION:
+        logger.warning(
+            "checkpoint %s: manifest format_version %s is newer than this "
+            "reader (%d); known fields are honored, unknown ones ignored",
+            path, manifest["format_version"], MANIFEST_VERSION,
+        )
     if not (
         os.path.isdir(os.path.join(path, "state"))
         or os.path.exists(os.path.join(path, "state.npz"))
@@ -405,7 +438,7 @@ def verify_checkpoint(state_dict, path):
             )
 
 
-def load_latest(state_dict, root=None, verify=True):
+def load_latest(state_dict, root=None, verify=True, data_loader=None):
     """Resume from the newest VALID checkpoint under `root` (default: the
     $PADDLE_CKPT_DIR the launch controller exports).
 
@@ -413,16 +446,31 @@ def load_latest(state_dict, root=None, verify=True):
     fails checksum verification is logged and skipped in favor of the next
     older — a torn or bit-rotted latest checkpoint degrades the resume
     point, never the job.  Returns the resumed step, or None when nothing
-    valid exists (fresh start)."""
+    valid exists (fresh start).
+
+    `data_loader`: restore the manifest's data-pipeline position
+    (``data_state``, v2 manifests) via ``set_state_dict`` so the resumed
+    epoch continues on the exact next batch.  v1 manifests have no data
+    state; the loader then starts its epoch from batch 0."""
     root = root or os.environ.get("PADDLE_CKPT_DIR") or ""
     if not root:
         return None
     candidates = sorted(_committed_steps(root), key=lambda sp: sp[0], reverse=True)
     for step, path in candidates:
         try:
-            load_state_dict(state_dict, path)
+            with _wd.arm("checkpoint.load", context=path):
+                load_state_dict(state_dict, path)
             if verify:
                 verify_checkpoint(state_dict, path)
+            if data_loader is not None:
+                manifest = read_commit_manifest(path) or {}
+                data_state = manifest.get("data_state")
+                if data_state and hasattr(data_loader, "set_state_dict"):
+                    data_loader.set_state_dict(data_state)
+                    logger.info(
+                        "restored data position: epoch %s, %s batches consumed",
+                        data_state.get("epoch"), data_state.get("batches_consumed"),
+                    )
             logger.info("resumed from checkpoint step %d (%s)", step, path)
             return step
         except Exception as e:

@@ -27,6 +27,8 @@ the input unchanged.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -47,16 +49,88 @@ class ReduceOp:
 
 
 class Task:
-    """Async task handle (reference: ProcessGroup::Task)."""
+    """Async task handle (reference: ProcessGroup::Task).
 
-    def __init__(self, tensors=None):
+    ``wait()`` is the sync point where a dead or hung peer manifests (in a
+    real multi-host job the collective never completes), so the cluster
+    fault domain hooks in here:
+
+    - ``wait(timeout=...)`` blocks in a helper thread and raises a
+      descriptive :class:`TimeoutError` naming the op and group axes when
+      the deadline passes — the caller decides what to do;
+    - ``wait()`` with no argument blocks inline under the fault watchdog:
+      if ``FLAGS_collective_timeout_sec`` > 0 and the block exceeds it, the
+      watchdog dumps all thread stacks and exits 75 so the launch
+      controller gang-restarts the job (a C-level ``block_until_ready``
+      cannot be interrupted from Python, hence exit rather than raise);
+    - before blocking, a peer ABORT marker (crash/preemption elsewhere in
+      the gang) turns into an immediate exit-75 instead of a hang.
+    """
+
+    def __init__(self, tensors=None, name=None, group=None):
         self._tensors = tensors or []
+        self._name = name or "collective"
+        self._group = group
 
-    def wait(self):
+    def _group_desc(self):
+        g = self._group
+        if g is None:
+            return "default group"
+        if g.axis_name is not None:
+            return f"mesh axis {g.axis_name!r} ({g.nranks} ranks)"
+        if g.ranks is not None:
+            return f"ranks {list(g.ranks)}"
+        return f"default group ({g.nranks} ranks)"
+
+    def _block(self):
+        from ..fault import injection as _inj
+
+        _inj.inject_hang("collective.hang", context=self._name)
         for t in self._tensors:
             arr = t._raw if isinstance(t, Tensor) else t
             if not isinstance(arr, jax.core.Tracer):
                 jax.block_until_ready(arr)
+
+    def wait(self, timeout=None):
+        from ..fault import heartbeat as _hb
+        from ..fault import watchdog as _wd
+
+        _hb.check_peer_abort()
+        if timeout is None:
+            with _wd.arm(f"collective.{self._name}.wait",
+                         context=self._group_desc()):
+                self._block()
+            return True
+        failure = []
+        done = threading.Event()
+
+        def _runner():
+            try:
+                self._block()
+            except BaseException as e:  # propagate to the waiting caller
+                failure.append(e)
+            finally:
+                done.set()
+
+        th = threading.Thread(
+            target=_runner, name=f"wait:{self._name}", daemon=True
+        )
+        th.start()
+        if not done.wait(float(timeout)):
+            from ..fault import injection as _inj
+
+            _inj.record_event(
+                "timeout", f"{self._name}.wait exceeded {float(timeout)}s"
+            )
+            raise TimeoutError(
+                f"collective {self._name!r} on {self._group_desc()} did not "
+                f"complete within {float(timeout)}s — a peer rank is likely "
+                "dead or hung; under the launch controller, heartbeat "
+                "staleness or the collective watchdog "
+                "(FLAGS_collective_timeout_sec) triggers a gang restart"
+            )
+        if failure:
+            raise failure[0]
         return True
 
     def is_completed(self):
@@ -281,7 +355,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 
     out = apply(f, [t], name="all_reduce")
     inplace_rebind(tensor, out)
-    return Task([tensor])
+    return Task([tensor], name="all_reduce", group=g)
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
@@ -309,7 +383,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     if tensor_list is not None:
         tensor_list.clear()
         tensor_list.extend(parts)
-    return Task(parts)
+    return Task(parts, name="all_gather", group=g)
 
 
 def all_gather_object(object_list, obj, group=None):
@@ -362,7 +436,7 @@ def reduce_scatter(tensor, tensor_list_or_tensor, op=ReduceOp.SUM, group=None, s
                 "pass a group-replicated input"
             )
     inplace_rebind(tensor, out)
-    return Task([tensor])
+    return Task([tensor], name="reduce_scatter", group=g)
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
@@ -383,7 +457,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
             name="broadcast",
         )
         inplace_rebind(tensor, out)
-        return Task([tensor])
+        return Task([tensor], name="broadcast", group=g)
 
     _no_traced_encoding(t, "broadcast", aname, n)
     d = _axis_dim(t._raw, aname)
@@ -394,11 +468,11 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
             return jax.lax.index_in_dim(blocks, srel, axis=d, keepdims=False)
 
         inplace_rebind(tensor, apply(f, [t], name="broadcast"))
-        return Task([tensor])
+        return Task([tensor], name="broadcast", group=g)
     if n > 1:
         _require_single_controller("broadcast")
     # replicated single-controller arrays are already consistent: true no-op
-    return Task([tensor])
+    return Task([tensor], name="broadcast", group=g)
 
 
 def broadcast_object_list(object_list, src=0, group=None):
@@ -436,7 +510,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
                 )
             r = g.rank if g.rank >= 0 else 0
             inplace_rebind(tensor, coerce(tensor_list[r]))
-    return Task([tensor])
+    return Task([tensor], name="scatter", group=g)
 
 
 def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
@@ -463,7 +537,7 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
         )
     out_tensor_list.clear()
     out_tensor_list.extend(parts)
-    return Task(parts)
+    return Task(parts, name="alltoall", group=g)
 
 
 def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=None, group=None, sync_op=True):
@@ -485,7 +559,7 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=
             "eager alltoall_single: see distributed.collective.alltoall"
         )
     inplace_rebind(out_tensor, out)
-    return Task([out_tensor])
+    return Task([out_tensor], name="alltoall_single", group=g)
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
@@ -504,7 +578,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
 
 def barrier(group=None):
     jax.block_until_ready(jnp.zeros(()))
-    return Task()
+    return Task(name="barrier", group=_get_group(group))
 
 
 def stream_allreduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True, use_calc_stream=False):

@@ -19,7 +19,15 @@ job is the multi-host contract:
   the master sees a peer go stale it bumps the epoch and the surviving
   nodes re-rendezvous — the job continues as long as >= min nodes
   (--nnodes min:max) re-register.  Node 0 hosting the store is the single
-  point of failure, as in the reference's etcd-less collective mode.
+  point of failure, as in the reference's etcd-less collective mode;
+- trainer liveness (fault.heartbeat): each trainer writes an atomic
+  per-rank heartbeat file (seq counter + step + status) into
+  $PADDLE_HEARTBEAT_DIR; the controller polls the seq counters and a rank
+  that stops advancing for --heartbeat_timeout, or drops an ABORT marker,
+  triggers a COORDINATED gang teardown (SIGTERM all -> --stop_grace ->
+  SIGKILL) and a gang relaunch of all ranks — charged to --max_restarts
+  with the usual backoff — which auto-resumes from
+  checkpoint.find_latest_valid via $PADDLE_CKPT_DIR.
 """
 
 from __future__ import annotations
@@ -77,8 +85,23 @@ def parse_args(argv=None):
         "relaunched trainer auto-resumes via distributed.checkpoint.load_latest",
     )
     p.add_argument("--host", type=str, default="")
-    p.add_argument("--hb_interval", type=float, default=2.0, help="heartbeat period (s)")
+    p.add_argument("--hb_interval", type=float, default=2.0, help="node-level heartbeat period (s) in the multi-node TCPStore")
     p.add_argument("--hb_timeout", type=float, default=10.0, help="declare a node dead after this many seconds without a heartbeat")
+    p.add_argument(
+        "--heartbeat_interval", type=float, default=1.0,
+        help="trainer heartbeat-file period (s), exported to trainers as "
+        "PADDLE_HEARTBEAT_INTERVAL (fault.Supervisor beats automatically)",
+    )
+    p.add_argument(
+        "--heartbeat_timeout", type=float, default=0.0,
+        help="gang-restart the job when a trainer's heartbeat file stops "
+        "advancing for this many seconds (0 disables; only ranks that have "
+        "written at least one heartbeat are watched)",
+    )
+    p.add_argument(
+        "--stop_grace", type=float, default=10.0,
+        help="gang teardown: seconds between SIGTERM and SIGKILL",
+    )
     p.add_argument("--rdv_grace", type=float, default=2.0, help="extra wait for stragglers after min nodes registered")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -129,6 +152,21 @@ class Container:
     def poll(self):
         return self.proc.poll() if self.proc else None
 
+    def signal_stop(self):
+        """First phase of a gang teardown: SIGTERM (lets fault.Supervisor
+        drain to a checkpoint); the controller escalates to SIGKILL after
+        the shared grace window."""
+        if self.proc and self.proc.poll() is None:
+            try:
+                self.proc.terminate()
+            except OSError:
+                pass
+
+    def close_log(self):
+        if self.log_file:
+            self.log_file.close()
+            self.log_file = None
+
     def terminate(self):
         if self.proc and self.proc.poll() is None:
             self.proc.terminate()
@@ -136,9 +174,7 @@ class Container:
                 self.proc.wait(10)
             except subprocess.TimeoutExpired:
                 self.proc.kill()
-        if self.log_file:
-            self.log_file.close()
-            self.log_file = None
+        self.close_log()
 
 
 class CollectiveController:
@@ -168,6 +204,9 @@ class CollectiveController:
         self.my_host = args.host or "127.0.0.1"
         self._hb_seen = {}  # node_id -> (counter, local time of last change)
         self._restarts = 0  # lives consumed from the restart budget
+        # trainer-level (heartbeat-file) liveness for the local gang
+        self.hb_dir = os.path.join(args.log_dir, "heartbeat")
+        self._trainer_hb = {}  # rank -> (seq, local time of last change)
 
     # -- store / rendezvous ------------------------------------------------
     def _connect_store(self):
@@ -244,6 +283,15 @@ class CollectiveController:
         extra["PADDLE_RESTART_NUM"] = str(self._restarts)
         if args.ckpt_dir:
             extra["PADDLE_CKPT_DIR"] = args.ckpt_dir
+        # liveness contract: trainers beat into hb_dir; a fresh gang must
+        # never read a dead life's heartbeat/ABORT state
+        from ...fault import heartbeat as _hbmod
+
+        os.makedirs(self.hb_dir, exist_ok=True)
+        _hbmod.clear(self.hb_dir)
+        self._trainer_hb = {}
+        extra["PADDLE_HEARTBEAT_DIR"] = self.hb_dir
+        extra["PADDLE_HEARTBEAT_INTERVAL"] = str(args.heartbeat_interval)
         self.containers = []
         for lr in range(nproc):
             grank = node_erank * nproc + lr
@@ -277,8 +325,7 @@ class CollectiveController:
                 # and retry within the same restart budget
                 print(f"[launch] spawn failed: {e}", file=sys.stderr)
                 code = 1
-            for c in self.containers:
-                c.terminate()
+            self._gang_stop()
             if code == 0:
                 return 0
             if code == "interrupt":
@@ -306,13 +353,14 @@ class CollectiveController:
                 args.restart_backoff_max,
             )
             why = (
-                "requested restart (preemption drain)"
+                "requested a gang restart (exit 75: preemption drain, "
+                "watchdog timeout, or health eviction)"
                 if code == RESTART_EXIT_CODE
                 else f"failed (exit {code})"
             )
             print(
-                f"[launch] child {why}; restart {restarts}/{args.max_restart} "
-                f"in {delay:.1f}s",
+                f"[launch] child {why}; gang restart {restarts}/"
+                f"{args.max_restart} in {delay:.1f}s",
                 file=sys.stderr,
             )
             time.sleep(delay)
@@ -323,7 +371,71 @@ class CollectiveController:
                 self.epoch += 1
                 node_erank, n_nodes, node_eps = self._rendezvous(self.epoch)
 
+    # -- gang teardown -----------------------------------------------------
+    def _gang_stop(self, grace=None):
+        """Coordinated teardown: SIGTERM every trainer FIRST (so all ranks
+        drain concurrently — fault.Supervisor turns it into a best-effort
+        checkpoint), then one shared grace window, then SIGKILL stragglers.
+        A partial teardown would leave surviving ranks deadlocked inside a
+        collective against the dead ones."""
+        grace = self.args.stop_grace if grace is None else grace
+        for c in self.containers:
+            c.signal_stop()
+        deadline = time.time() + grace
+        stragglers = []
+        for c in self.containers:
+            if c.proc and c.proc.poll() is None:
+                try:
+                    c.proc.wait(max(0.1, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    stragglers.append(c)
+        for c in stragglers:
+            print(
+                f"[launch] rank {c.rank} ignored SIGTERM for {grace:.1f}s; killing",
+                file=sys.stderr,
+            )
+            try:
+                c.proc.kill()
+                c.proc.wait(5)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+        for c in self.containers:
+            c.close_log()
+
     # -- watch -------------------------------------------------------------
+    def _trainer_health(self, now):
+        """Trainer-file liveness for the local gang: an ABORT marker or a
+        stale heartbeat (seq counter unchanged for --heartbeat_timeout of
+        the CONTROLLER's clock — no cross-process clock comparison) turns
+        into a gang restart charged to the normal restart budget."""
+        from ...fault import heartbeat as _hbmod
+
+        aborts = _hbmod.scan_aborts(self.hb_dir)
+        for rank, info in sorted(aborts.items()):
+            print(
+                f"[launch] rank {rank} dropped ABORT marker "
+                f"({info.get('reason', '?')}); gang restart",
+                file=sys.stderr,
+            )
+            return RESTART_EXIT_CODE
+        if self.args.heartbeat_timeout <= 0:
+            return None
+        for rank, payload in _hbmod.scan_heartbeats(self.hb_dir).items():
+            seq = payload.get("seq", 0)
+            last = self._trainer_hb.get(rank)
+            if last is None or seq != last[0]:
+                self._trainer_hb[rank] = (seq, now)
+            elif now - last[1] > self.args.heartbeat_timeout:
+                print(
+                    f"[launch] rank {rank} heartbeat stale for "
+                    f"{now - last[1]:.1f}s (last step {payload.get('step')}, "
+                    f"status {payload.get('status')}, pid {payload.get('pid')}); "
+                    "gang restart",
+                    file=sys.stderr,
+                )
+                return RESTART_EXIT_CODE
+        return None
+
     def _heartbeat(self, now):
         st = self.store
         st.add(f"hb/{self.node_rank}", 1)
@@ -348,13 +460,29 @@ class CollectiveController:
 
     def watch(self, multi=False, n_nodes=1):
         last_hb = 0.0
+        last_health = 0.0
         try:
             while True:
                 codes = [c.poll() for c in self.containers]
                 if any(c is not None and c != 0 for c in codes):
-                    return next(c for c in codes if c is not None and c != 0)
+                    dead = next(
+                        (c, rc) for c, rc in zip(self.containers, codes)
+                        if rc is not None and rc != 0
+                    )
+                    print(
+                        f"[launch] rank {dead[0].rank} exited {dead[1]}; "
+                        "tearing the gang down",
+                        file=sys.stderr,
+                    )
+                    return dead[1]
                 if all(c == 0 for c in codes):
                     return 0
+                hnow = time.time()
+                if hnow - last_health >= min(self.args.heartbeat_interval, 1.0):
+                    last_health = hnow
+                    verdict = self._trainer_health(hnow)
+                    if verdict is not None:
+                        return verdict
                 if multi:
                     now = time.time()
                     try:

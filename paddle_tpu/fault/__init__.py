@@ -19,10 +19,25 @@ Exit-code contract with ``paddle_tpu.distributed.launch``: a trainer
 exiting with ``RESTART_EXIT_CODE`` (75, EX_TEMPFAIL) asks the launcher
 to relaunch it (with exponential backoff, bounded by ``--max_restarts``)
 and to point it at the checkpoint tree via ``PADDLE_CKPT_DIR``.
+
+The cluster-level fault domain (PR 2) adds:
+
+- ``heartbeat`` — per-rank heartbeat files + ABORT markers under
+  ``$PADDLE_HEARTBEAT_DIR``; the launch controller polls them and gang-
+  restarts ALL ranks (SIGTERM → grace → SIGKILL, then relaunch from
+  ``find_latest_valid``) when a rank goes stale or drops an ABORT marker;
+- ``watchdog`` — deadline tracking for blocking regions (collective
+  ``Task.wait``, checkpoint save/load, data-loader ``next``, the fit
+  step).  A region exceeding ``FLAGS_collective_timeout_sec`` dumps every
+  thread stack plus the last fault/heartbeat events and exits 75 so the
+  gang restart takes over instead of burning hardware inside a hung
+  collective.
 """
 
 from __future__ import annotations
 
+from . import heartbeat, watchdog  # noqa: F401
+from .heartbeat import HeartbeatWriter, PeerAbort  # noqa: F401
 from .injection import (  # noqa: F401
     InjectedFault,
     arm,
@@ -30,6 +45,9 @@ from .injection import (  # noqa: F401
     fault_points,
     hits,
     inject,
+    inject_hang,
+    recent_events,
+    record_event,
     register,
 )
 from .supervisor import (  # noqa: F401
@@ -39,3 +57,4 @@ from .supervisor import (  # noqa: F401
     Supervisor,
     run_supervised,
 )
+from .watchdog import Watchdog, WatchdogTimeout, dump_stacks  # noqa: F401

@@ -21,8 +21,10 @@ unconditionally.
 
 from __future__ import annotations
 
+import collections
 import logging
 import threading
+import time
 
 from ..framework import core as _core
 
@@ -34,6 +36,12 @@ _core.define_flag(
     "comma-separated fault points to arm: name[:count|*] "
     "(e.g. 'checkpoint.save:2,dataloader.next')",
 )
+_core.define_flag(
+    "FLAGS_fault_hang_sec",
+    3600.0,
+    "how long an armed *.hang fault point blocks (default: long enough that "
+    "the watchdog/controller, not the sleep, ends the hang)",
+)
 
 ALWAYS = -1  # sentinel count for 'name:*'
 
@@ -42,6 +50,23 @@ _registry = {}  # name -> doc (every point ever declared or reached)
 _armed = {}  # name -> remaining fire count (ALWAYS = unlimited)
 _hits = {}  # name -> times an ARMED point was reached
 _parsed_spec = None  # last spec parsed into _armed (re-parse on change)
+
+
+# ring buffer of recent fault-layer events (injections, hangs, heartbeats,
+# watchdog firings) — dumped by the watchdog alongside thread stacks so a
+# timeout post-mortem shows what the rank was doing when it stalled
+_events = collections.deque(maxlen=64)
+
+
+def record_event(kind, detail=""):
+    """Append to the fault-event ring buffer (thread-safe: deque append)."""
+    _events.append({"t": time.monotonic(), "kind": kind, "detail": str(detail)})
+
+
+def recent_events(n=None):
+    """The last `n` (default: all retained) fault-layer events, oldest first."""
+    evs = list(_events)
+    return evs if n is None else evs[-n:]
 
 
 class InjectedFault(RuntimeError):
@@ -126,33 +151,59 @@ def hits(name):
     return _hits.get(name, 0)
 
 
+def _consume(name):
+    """True when `name` is armed with shots left (consumes one shot)."""
+    _sync_from_flag()
+    if not _armed:
+        _registry.setdefault(name, "")
+        return False
+    with _lock:
+        remaining = _armed.get(name)
+        _registry.setdefault(name, "")
+        if remaining is None:
+            return False
+        _hits[name] = _hits.get(name, 0) + 1
+        if remaining == 0:
+            return False
+        if remaining > 0:
+            _armed[name] = remaining - 1
+    return True
+
+
 def inject(name, context=None):
     """Fault point: raise InjectedFault if `name` is armed with shots left.
 
     Call this at the spot where the real failure would surface; the
     recovery path around it then serves both chaos tests and production.
     """
-    _sync_from_flag()
-    if not _armed:
-        _registry.setdefault(name, "")
+    if not _consume(name):
         return
-    with _lock:
-        remaining = _armed.get(name)
-        _registry.setdefault(name, "")
-        if remaining is None:
-            return
-        _hits[name] = _hits.get(name, 0) + 1
-        if remaining == 0:
-            return
-        if remaining > 0:
-            _armed[name] = remaining - 1
     logger.warning("fault point %r firing (context=%s)", name, context)
+    record_event("inject", f"{name} ({context})" if context else name)
     raise InjectedFault(name, context)
+
+
+def inject_hang(name, context=None, hang_sec=None):
+    """Hang-flavored fault point: an armed `name` BLOCKS (sleeps
+    FLAGS_fault_hang_sec) instead of raising, standing in for a peer-dead
+    collective, a wedged filesystem, or a stalled data source — the class
+    of failure only the watchdog/heartbeat layer can detect."""
+    if not _consume(name):
+        return
+    if hang_sec is None:
+        hang_sec = float(_core.flag("FLAGS_fault_hang_sec"))
+    logger.warning(
+        "fault point %r hanging for %.1fs (context=%s)", name, hang_sec, context
+    )
+    record_event("hang", f"{name} for {hang_sec:.1f}s ({context})" if context else f"{name} for {hang_sec:.1f}s")
+    time.sleep(hang_sec)
 
 
 # Built-in fault points wired through the runtime (checkpoint.* are
 # registered by distributed/checkpoint.py next to their sites):
 register("dataloader.next", "fires before the data loader produces each batch")
+register("dataloader.hang", "HANGS the data loader mid-batch (watchdog drill)")
 register("collective.all_reduce", "fires at the entry of collective.all_reduce")
+register("collective.hang", "HANGS inside a collective Task.wait (watchdog drill)")
 register("launch.spawn", "fires when the launch controller spawns a trainer")
 register("supervisor.step", "fires inside Supervisor.after_step")

@@ -32,6 +32,7 @@ import signal as _signal
 import threading
 
 from . import injection as _inj
+from . import heartbeat as _hb
 
 logger = logging.getLogger("paddle_tpu")
 
@@ -93,6 +94,10 @@ class Supervisor:
         self._scaler = None
         self._prev_handlers = {}
         self._lock = threading.Lock()
+        # cluster liveness: under a launched job the controller exports
+        # PADDLE_HEARTBEAT_DIR and this rank's heartbeat thread starts here;
+        # standalone runs get None and every hook below is a no-op
+        self.heartbeat = _hb.maybe_start()
         if handle_signals:
             self._install()
 
@@ -156,6 +161,11 @@ class Supervisor:
         so a pending preemption turns into checkpoint + exit."""
         _inj.inject("supervisor.step")
         self.step += 1
+        if self.heartbeat is not None:
+            # progress signal: the beat carries the step, so the controller's
+            # diagnostic on a stall names where training stopped advancing
+            self.heartbeat.beat(step=self.step)
+        _hb.check_peer_abort()  # a dead peer => exit 75, don't enter the next collective
         bad = not _is_finite(loss) or self._scaler_found_inf()
         if bad:
             self.bad_steps += 1
@@ -195,6 +205,9 @@ class Supervisor:
         if not self.preempted:
             return
         self._best_effort_save(f"preemption signal {self._signum}")
+        # tell surviving peers not to enter the next collective: they exit 75
+        # and the controller gang-restarts everyone from the checkpoint
+        _hb.write_abort(f"preempted (signal {self._signum})")
         self.uninstall()
         raise RestartRequested(f"signal {self._signum}")
 
@@ -207,8 +220,9 @@ class Supervisor:
             yield self
         except (RestartRequested, KeyboardInterrupt):
             raise
-        except Exception:
+        except Exception as e:
             self._best_effort_save("crash")
+            _hb.write_abort(f"crash: {type(e).__name__}: {e}")
             raise
 
 

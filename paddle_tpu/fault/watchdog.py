@@ -1,0 +1,199 @@
+"""Hang watchdog: deadline-tracked blocking regions with stack-dump on
+expiry.
+
+A hung collective (peer died mid-all-reduce), a wedged checkpoint write,
+or a stalled data loader blocks the trainer in a C call Python cannot
+interrupt — the job burns hardware until an external timeout kills it.
+The watchdog moves detection in-process: ``arm(region)`` (a context
+manager) registers a deadline with a single monitor thread; a region
+that overruns dumps EVERY Python thread's stack plus the last fault-
+point/heartbeat events to stderr, then acts:
+
+``exit``   (default) ``os._exit(75)`` — the blocked call may never
+           return, so the only safe move is to die with the restart-
+           requested code and let the launch controller gang-restart
+           all ranks from the latest valid checkpoint.
+``raise``  mark the region; :class:`WatchdogTimeout` is raised from the
+           arming thread when the blocked call eventually returns
+           (tests, or regions known to complete late rather than never).
+callable   invoked as ``action(region, elapsed)`` — test instrumentation.
+
+The default timeout is ``FLAGS_collective_timeout_sec`` (0 disables:
+an unarmed ``arm()`` costs one flag read and no lock).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+
+from ..framework import core as _core
+
+logger = logging.getLogger("paddle_tpu")
+
+_core.define_flag(
+    "FLAGS_collective_timeout_sec",
+    0.0,
+    "watchdog deadline (s) for blocking regions: collective wait, checkpoint "
+    "save/load, dataloader next, fit step.  0 disables the watchdog.",
+)
+
+EVENT_DUMP_N = 32  # fault/heartbeat events included in a timeout dump
+
+
+class WatchdogTimeout(TimeoutError):
+    """A watchdog-armed region exceeded its deadline."""
+
+    def __init__(self, region, timeout):
+        self.region = region
+        self.timeout = timeout
+        super().__init__(
+            f"watchdog: region {region!r} exceeded {timeout:.1f}s "
+            "(FLAGS_collective_timeout_sec); thread stacks were dumped to stderr"
+        )
+
+
+def dump_stacks(file=None, note=""):
+    """Write every Python thread's stack + the recent fault-point and
+    heartbeat events to `file` (stderr) — the post-mortem a hung rank
+    leaves behind before the controller tears the gang down."""
+    file = file or sys.stderr
+    lines = [f"[watchdog] {note}" if note else "[watchdog] thread dump"]
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for tid, frame in sys._current_frames().items():
+        lines.append(f"--- thread {names.get(tid, '?')} (id {tid}) ---")
+        lines.extend(l.rstrip() for l in traceback.format_stack(frame))
+    from . import injection as _inj
+
+    events = _inj.recent_events(EVENT_DUMP_N)
+    lines.append(f"--- last {len(events)} fault/heartbeat events ---")
+    for ev in events:
+        lines.append(f"  {ev['t']:.3f} [{ev['kind']}] {ev['detail']}")
+    try:
+        print("\n".join(lines), file=file, flush=True)
+    except OSError:
+        pass
+
+
+class _Region:
+    __slots__ = ("id", "region", "deadline", "timeout", "context", "watchdog", "fired")
+
+    def __init__(self, id, region, deadline, timeout, context, watchdog):
+        self.id = id
+        self.region = region
+        self.deadline = deadline
+        self.timeout = timeout
+        self.context = context
+        self.watchdog = watchdog
+        self.fired = False
+
+
+_regions = {}  # id -> _Region
+_cv = threading.Condition()
+_ids = itertools.count(1)
+_monitor = None
+
+
+def _ensure_monitor():
+    global _monitor
+    if _monitor is not None and _monitor.is_alive():
+        return
+    _monitor = threading.Thread(target=_monitor_loop, name="fault-watchdog", daemon=True)
+    _monitor.start()
+
+
+def _monitor_loop():
+    while True:
+        with _cv:
+            live = [r for r in _regions.values() if not r.fired]
+            if not live:
+                _cv.wait(timeout=60)
+                continue
+            now = time.monotonic()
+            nearest = min(r.deadline for r in live)
+            if nearest > now:
+                _cv.wait(timeout=nearest - now)
+                continue
+            expired = [r for r in live if r.deadline <= now]
+            for r in expired:
+                r.fired = True
+        for r in expired:  # fire OUTSIDE the lock: actions may be slow/exit
+            _fire(r)
+
+
+def _fire(r):
+    note = (
+        f"region {r.region!r} exceeded {r.timeout:.1f}s"
+        + (f" (context: {r.context})" if r.context else "")
+        + " — dumping all thread stacks"
+    )
+    logger.error("watchdog fired: %s", note)
+    dump_stacks(note=note)
+    from . import injection as _inj
+
+    _inj.record_event("watchdog", f"fired: {r.region} after {r.timeout:.1f}s")
+    action = r.watchdog.action
+    if callable(action):
+        action(r.region, r.timeout)
+    elif action == "raise":
+        pass  # arm() raises WatchdogTimeout when the region exits
+    else:  # "exit": the blocked call may never return — die for the gang
+        from .supervisor import RESTART_EXIT_CODE
+
+        from . import heartbeat as _hb
+
+        _hb.write_abort(f"watchdog: {r.region} exceeded {r.timeout:.1f}s")
+        os._exit(RESTART_EXIT_CODE)
+
+
+class Watchdog:
+    """Deadline tracker for blocking regions.  One module-level instance
+    (:data:`default`) serves the runtime wiring; tests construct their own
+    with a callback/raise action."""
+
+    def __init__(self, timeout=None, action="exit"):
+        self.timeout = timeout
+        self.action = action
+
+    def _resolve_timeout(self, timeout):
+        if timeout is not None:
+            return float(timeout)
+        if self.timeout is not None:
+            return float(self.timeout)
+        return float(_core.flag("FLAGS_collective_timeout_sec"))
+
+    @contextlib.contextmanager
+    def arm(self, region, timeout=None, context=None):
+        """Guard a blocking region; disarmed (timeout <= 0) this is a
+        plain passthrough so hot paths can arm unconditionally."""
+        t = self._resolve_timeout(timeout)
+        if t <= 0:
+            yield
+            return
+        _ensure_monitor()
+        r = _Region(next(_ids), region, time.monotonic() + t, t, context, self)
+        with _cv:
+            _regions[r.id] = r
+            _cv.notify()
+        try:
+            yield
+        finally:
+            with _cv:
+                _regions.pop(r.id, None)
+            if r.fired and self.action == "raise":
+                raise WatchdogTimeout(region, t)
+
+
+default = Watchdog()
+
+
+def arm(region, timeout=None, context=None):
+    """Arm the default watchdog around a blocking region (no-op when
+    FLAGS_collective_timeout_sec is 0 and no explicit timeout is given)."""
+    return default.arm(region, timeout=timeout, context=context)

@@ -186,6 +186,7 @@ class Model:
         with the restart-requested code the launch controller honors.
         Pass max_bad_steps=0 to disable the watchdog."""
         from ..fault import Supervisor
+        from ..fault import watchdog as _wd
 
         loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
             train_data, batch_size=batch_size, shuffle=shuffle, drop_last=drop_last, num_workers=num_workers
@@ -216,7 +217,7 @@ class Model:
                 for step, batch in enumerate(loader):
                     cblist.call("on_train_batch_begin", step)
                     x, y = batch[0], batch[1]
-                    with sup.guard():
+                    with sup.guard(), _wd.arm("fit.train_batch", context=f"step {step}"):
                         loss = self.train_batch(x, y)[0]
                     losses.append(loss)
                     logs = {"loss": loss, **getattr(self, "_last_metrics", {})}

@@ -241,6 +241,12 @@ class BucketSampler(Sampler):
     def set_epoch(self, epoch):
         self.epoch = epoch
 
+    def state_dict(self):
+        return {"epoch": int(self.epoch)}
+
+    def set_state_dict(self, state):
+        self.set_epoch(int(state.get("epoch", 0)))
+
     def __iter__(self):
         rng = np.random.RandomState(self.seed + self.epoch) if self.shuffle else None
         batches = []
@@ -351,6 +357,15 @@ class DistributedBatchSampler(BatchSampler):
     def set_epoch(self, epoch):
         self.epoch = epoch
 
+    def state_dict(self):
+        # the epoch seeds the shuffle, so it fully determines this
+        # sampler's order — (epoch, batches_consumed) in the loader state
+        # pins the exact next batch after a gang restart
+        return {"epoch": int(self.epoch)}
+
+    def set_state_dict(self, state):
+        self.set_epoch(int(state.get("epoch", 0)))
+
 
 # ---------------------------------------------------------------------------
 # collate + loader
@@ -430,53 +445,150 @@ class DataLoader:
             self.batch_sampler = BatchSampler(
                 dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last
             )
+        # exactly-once resume state (persisted in the checkpoint manifest via
+        # checkpoint.save_checkpoint(data_loader=...)): epoch ordinal, batches
+        # the CONSUMER has taken this epoch, the global RNG state snapshotted
+        # at epoch start (it determines every shuffle drawn from
+        # default_generator), and the prefetch-queue high-water mark
+        self._epoch = 0
+        self._batches_consumed = 0
+        self._resume_skip = 0
+        self._epoch_rng_state = None
+        self._prefetch_hwm = 0
 
     def __len__(self):
         if self._iterable_mode:
             raise TypeError("IterableDataset has no len()")
         return len(self.batch_sampler)
 
-    def _iter_batches(self):
+    # -- exactly-once resume ------------------------------------------------
+    def state_dict(self):
+        """Data-pipeline position for exactly-once resume.
+
+        ``batches_consumed`` counts batches the consumer has TAKEN from the
+        iterator (not what prefetch produced), so restoring it and skipping
+        that many index-batches replays nothing and drops nothing — the
+        resumed run's first batch is the exact next one."""
+        rng = self._epoch_rng_state
+        if rng is None:
+            rng = np.asarray(default_generator.get_state()).tolist()
+        state = {
+            "epoch": int(self._epoch),
+            "batches_consumed": int(self._batches_consumed),
+            "rng_state": rng,
+            "prefetch_hwm": int(self._prefetch_hwm),
+        }
+        bs = self.batch_sampler
+        if bs is not None and hasattr(bs, "state_dict"):
+            state["sampler"] = bs.state_dict()
+        return state
+
+    def set_state_dict(self, state):
+        self._epoch = int(state.get("epoch", 0))
+        self._resume_skip = int(state.get("batches_consumed", 0))
+        self._batches_consumed = self._resume_skip
+        self._prefetch_hwm = int(state.get("prefetch_hwm", 0))
+        rng = state.get("rng_state")
+        if rng is not None:
+            # restoring the generator replays the epoch's sampler key draws,
+            # so the skipped index-batches are the ones already consumed
+            self._epoch_rng_state = [int(x) for x in rng]
+            default_generator.set_state(np.asarray(rng, np.uint32))
+        bs = self.batch_sampler
+        samp = state.get("sampler")
+        if bs is not None and samp is not None:
+            if hasattr(bs, "set_state_dict"):
+                bs.set_state_dict(samp)
+            elif hasattr(bs, "set_epoch"):
+                bs.set_epoch(int(samp.get("epoch", 0)))
+        return self
+
+    load_state_dict = set_state_dict
+
+    def _iter_batches(self, skip=0):
         from ..fault import injection as _inj
 
         if self._iterable_mode:
+            # no random access: count batch boundaries and discard the first
+            # `skip` WITHOUT collating them
+            emitted = 0
             batch = []
             for sample in self.dataset:
                 batch.append(sample)
                 if len(batch) == self.batch_size:
-                    _inj.inject("dataloader.next")
-                    yield self.collate_fn(batch)
+                    if emitted >= skip:
+                        _inj.inject("dataloader.next")
+                        yield self.collate_fn(batch)
+                    emitted += 1
                     batch = []
             if batch and not self.drop_last:
-                _inj.inject("dataloader.next")
-                yield self.collate_fn(batch)
+                if emitted >= skip:
+                    _inj.inject("dataloader.next")
+                    yield self.collate_fn(batch)
         else:
-            for idx_batch in self.batch_sampler:
+            # skip at the index level: consumed batches are never fetched
+            # from the dataset again
+            for bi, idx_batch in enumerate(self.batch_sampler):
+                if bi < skip:
+                    continue
                 _inj.inject("dataloader.next")
                 samples = [self.dataset[i] for i in idx_batch]
                 yield self.collate_fn(samples)
 
     def __iter__(self):
+        from ..fault import injection as _inj
+        from ..fault import watchdog as _wd
+
+        skip = self._resume_skip
+        self._resume_skip = 0
+        if skip == 0 or self._epoch_rng_state is None:
+            # snapshot BEFORE the sampler draws its shuffle key, so a
+            # checkpoint taken mid-epoch can replay the same order
+            self._epoch_rng_state = np.asarray(default_generator.get_state()).tolist()
+        self._batches_consumed = skip
+        src = self._make_iter(skip)
+        while True:
+            with _wd.arm("dataloader.next"):
+                _inj.inject_hang("dataloader.hang")
+                try:
+                    batch = next(src)
+                except StopIteration:
+                    break
+            # counted before the consumer runs the step: a checkpoint taken
+            # while batch k is being processed reports k+1 consumed
+            self._batches_consumed += 1
+            yield batch
+        self._epoch += 1
+        self._batches_consumed = 0
+        self._epoch_rng_state = None
+
+    def _make_iter(self, skip):
         if self.num_workers == 0:
-            yield from self._iter_batches()
+            yield from self._iter_batches(skip)
             return
         if self.use_shared_memory and not self._iterable_mode:
             try:
-                yield from self._iter_multiprocess()
+                yield from self._iter_multiprocess(skip)
                 return
             except _MPUnavailable:
                 pass  # e.g. non-picklable dataset: thread prefetch below
-        yield from self._iter_threaded()
+        yield from self._iter_threaded(skip)
 
-    def _iter_threaded(self):
+    def _iter_threaded(self, skip=0):
         # background-thread prefetch pipeline (GIL-bound but zero-copy)
         q = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
         sentinel = object()
 
         def producer():
             try:
-                for b in self._iter_batches():
+                for b in self._iter_batches(skip):
                     q.put(b)
+                    if q.qsize() > self._prefetch_hwm:
+                        self._prefetch_hwm = q.qsize()
+            except BaseException as e:
+                # poison pill: without it a dying producer looks like a
+                # clean end-of-epoch and the error is silently swallowed
+                q.put(_Poison(e))
             finally:
                 q.put(sentinel)
 
@@ -486,9 +598,11 @@ class DataLoader:
             item = q.get()
             if item is sentinel:
                 break
+            if isinstance(item, _Poison):
+                raise item.exc
             yield item
 
-    def _iter_multiprocess(self):
+    def _iter_multiprocess(self, skip=0):
         """Multiprocess workers (reference: paddle.io.DataLoader
         num_workers>0 — _DataLoaderIterMultiProcess): each worker process
         collates whole index-batches; results return via pickle over a
@@ -501,7 +615,7 @@ class DataLoader:
         except ValueError as e:
             raise _MPUnavailable(str(e))
 
-        batches = list(self.batch_sampler)
+        batches = list(self.batch_sampler)[skip:]
         nw = min(self.num_workers, max(len(batches), 1))
         task_q = ctx.Queue()
         out_q = ctx.Queue(maxsize=nw * self.prefetch_factor)
@@ -580,6 +694,15 @@ class DataLoader:
 
 class _MPUnavailable(RuntimeError):
     pass
+
+
+class _Poison:
+    """Queue marker carrying a worker-thread exception to the consumer."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
 
 
 class WorkerInfo:

@@ -563,3 +563,455 @@ class TestLaunchRestarts:
         assert not (tmp_path / "done.0").exists(), "life 0 should have exited"
         latest = ckpt.find_latest_valid(str(root))
         assert latest is not None and latest[0] == 6
+
+
+# --------------------------------------------------- heartbeat (PR 2 tentpole)
+
+from paddle_tpu.fault import heartbeat as hb
+from paddle_tpu.fault import watchdog as wd
+
+
+class TestHeartbeat:
+    @pytest.fixture(autouse=True)
+    def _no_active_writer(self):
+        yield
+        hb.reset()
+
+    def test_beat_advances_seq_and_carries_step(self, tmp_path):
+        w = hb.HeartbeatWriter(tmp_path, rank=0, interval=0)
+        w.beat(step=7)
+        got = hb.scan_heartbeats(str(tmp_path))
+        assert got[0]["seq"] == 2  # one beat at construction + one manual
+        assert got[0]["step"] == 7
+        assert got[0]["status"] == hb.STATUS_RUNNING
+        assert got[0]["pid"] == os.getpid()
+
+    def test_atomic_writes_leave_no_partial_files(self, tmp_path):
+        w = hb.HeartbeatWriter(tmp_path, rank=1, interval=0)
+        for _ in range(20):
+            w.beat()
+        assert not [n for n in os.listdir(tmp_path) if ".tmp." in n]
+
+    def test_abort_marker_and_peer_check(self, tmp_path):
+        w = hb.HeartbeatWriter(tmp_path, rank=1, interval=0)
+        w.abort("synthetic crash")
+        aborts = hb.scan_aborts(str(tmp_path))
+        assert aborts[1]["reason"] == "synthetic crash"
+        # a rank's OWN marker must not evict it (it is already dying)
+        hb.check_peer_abort(str(tmp_path), self_rank=1)
+        with pytest.raises(hb.PeerAbort) as ei:
+            hb.check_peer_abort(str(tmp_path), self_rank=0)
+        assert ei.value.code == fault.RESTART_EXIT_CODE
+        assert ei.value.rank == 1
+
+    def test_clear_resets_the_directory(self, tmp_path):
+        w = hb.HeartbeatWriter(tmp_path, rank=0, interval=0)
+        w.abort("x")
+        hb.clear(str(tmp_path))
+        assert hb.scan_heartbeats(str(tmp_path)) == {}
+        assert hb.scan_aborts(str(tmp_path)) == {}
+
+    def test_maybe_start_env_contract(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(hb.ENV_DIR, str(tmp_path))
+        monkeypatch.setenv(hb.ENV_RANK, "3")
+        monkeypatch.setenv(hb.ENV_INTERVAL, "0")
+        w = hb.maybe_start()
+        assert w is not None and w.rank == 3
+        assert hb.maybe_start() is w, "second start must be idempotent"
+        assert 3 in hb.scan_heartbeats(str(tmp_path))
+
+    def test_maybe_start_noop_standalone(self, monkeypatch):
+        monkeypatch.delenv(hb.ENV_DIR, raising=False)
+        assert hb.maybe_start() is None
+
+    def test_supervisor_step_checks_peers(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(hb.ENV_DIR, str(tmp_path))
+        monkeypatch.setenv(hb.ENV_RANK, "0")
+        monkeypatch.setenv(hb.ENV_INTERVAL, "0")
+        sup = fault.Supervisor(handle_signals=False)
+        sup.after_step(1.0)  # healthy gang: no raise
+        assert hb.scan_heartbeats(str(tmp_path))[0]["step"] == 1
+        hb.write_abort("peer crash", rank=1, root=str(tmp_path))
+        with pytest.raises(hb.PeerAbort):
+            sup.after_step(1.0)
+
+
+# ----------------------------------------------------- watchdog (PR 2 tentpole)
+
+class TestWatchdog:
+    def test_disarmed_is_passthrough(self):
+        paddle.set_flags({"FLAGS_collective_timeout_sec": 0.0})
+        with wd.arm("test.region"):
+            pass
+        assert not wd._regions
+
+    def test_callable_action_fires_on_overrun(self):
+        fired = []
+        w = fault.Watchdog(timeout=0.15,
+                           action=lambda region, t: fired.append(region))
+        with w.arm("test.slow", context="unit"):
+            time.sleep(0.5)
+        assert fired == ["test.slow"]
+        assert not wd._regions
+
+    def test_raise_action_raises_at_region_exit(self):
+        w = fault.Watchdog(timeout=0.15, action="raise")
+        with pytest.raises(fault.WatchdogTimeout, match="test.late"):
+            with w.arm("test.late"):
+                time.sleep(0.5)
+
+    def test_fast_region_never_fires(self):
+        fired = []
+        w = fault.Watchdog(timeout=5.0, action=lambda *a: fired.append(a))
+        for _ in range(3):
+            with w.arm("test.fast"):
+                pass
+        assert fired == [] and not wd._regions
+
+    def test_dump_stacks_contents(self):
+        import io
+        _inj.record_event("unit", "hello-marker")
+        buf = io.StringIO()
+        fault.dump_stacks(file=buf, note="unit dump")
+        out = buf.getvalue()
+        assert "unit dump" in out
+        assert "MainThread" in out          # every thread's stack is present
+        assert "hello-marker" in out        # recent fault events ride along
+
+
+class TestHangInjection:
+    def test_disarmed_is_noop(self):
+        t0 = time.monotonic()
+        _inj.inject_hang("collective.hang", hang_sec=5.0)
+        assert time.monotonic() - t0 < 1.0
+
+    def test_armed_hang_sleeps_and_counts(self):
+        fault.arm("collective.hang:1")
+        t0 = time.monotonic()
+        _inj.inject_hang("collective.hang", hang_sec=0.3)
+        assert time.monotonic() - t0 >= 0.3
+        assert fault.hits("collective.hang") == 1
+        t0 = time.monotonic()
+        _inj.inject_hang("collective.hang", hang_sec=5.0)  # shot spent
+        assert time.monotonic() - t0 < 1.0
+
+    def test_flag_controls_hang_duration(self):
+        paddle.set_flags({"FLAGS_fault_hang_sec": 0.2})
+        try:
+            fault.arm("dataloader.hang:1")
+            t0 = time.monotonic()
+            _inj.inject_hang("dataloader.hang")
+            assert time.monotonic() - t0 >= 0.2
+        finally:
+            paddle.set_flags({"FLAGS_fault_hang_sec": 3600.0})
+
+    def test_hang_points_registered(self):
+        pts = fault.fault_points()
+        assert "collective.hang" in pts and "dataloader.hang" in pts
+
+
+# ---------------------------------------- collective timeouts (PR 2 satellite)
+
+class TestCollectiveTimeout:
+    def test_wait_timeout_names_op_and_group(self):
+        from paddle_tpu.distributed import collective
+        t = paddle.to_tensor(np.ones((4,), np.float32))
+        task = collective.all_reduce(t)
+        fault.arm("collective.hang:1")
+        paddle.set_flags({"FLAGS_fault_hang_sec": 3.0})
+        try:
+            with pytest.raises(TimeoutError, match="all_reduce"):
+                task.wait(timeout=0.3)
+        finally:
+            paddle.set_flags({"FLAGS_fault_hang_sec": 3600.0})
+
+    def test_wait_completes_within_timeout(self):
+        from paddle_tpu.distributed import collective
+        t = paddle.to_tensor(np.ones((4,), np.float32))
+        assert collective.all_reduce(t).wait(timeout=60) is True
+
+    def test_wait_no_timeout_arms_the_watchdog(self):
+        from paddle_tpu.distributed import collective
+        fired = []
+        old_action = wd.default.action
+        wd.default.action = lambda region, t: fired.append(region)
+        paddle.set_flags({"FLAGS_collective_timeout_sec": 0.2,
+                          "FLAGS_fault_hang_sec": 0.6})
+        fault.arm("collective.hang:1")
+        try:
+            t = paddle.to_tensor(np.ones((2,), np.float32))
+            collective.all_reduce(t).wait()
+            assert fired == ["collective.all_reduce.wait"]
+        finally:
+            wd.default.action = old_action
+            paddle.set_flags({"FLAGS_collective_timeout_sec": 0.0,
+                              "FLAGS_fault_hang_sec": 3600.0})
+
+    def test_peer_abort_preempts_the_wait(self, tmp_path, monkeypatch):
+        from paddle_tpu.distributed import collective
+        monkeypatch.setenv(hb.ENV_DIR, str(tmp_path))
+        monkeypatch.setenv(hb.ENV_RANK, "0")
+        hb.write_abort("peer died", rank=1, root=str(tmp_path))
+        task = collective.all_reduce(paddle.to_tensor(np.ones((2,), np.float32)))
+        with pytest.raises(hb.PeerAbort):
+            task.wait()
+
+
+# ------------------------------------- exactly-once data resume (PR 2 tentpole)
+
+class TestDataResume:
+    def _ds(self, n=20):
+        return paddle.io.TensorDataset(
+            [paddle.to_tensor(np.arange(n, dtype=np.float32).reshape(n, 1))]
+        )
+
+    @staticmethod
+    def _ids(batches):
+        return [b[0].numpy()[:, 0].astype(int).tolist() for b in batches]
+
+    def test_sequential_resume_exact_next_batch(self):
+        ds = self._ds()
+        ref = self._ids(list(paddle.io.DataLoader(ds, batch_size=2)))
+        dl = paddle.io.DataLoader(ds, batch_size=2)
+        it = iter(dl)
+        seen = self._ids([next(it) for _ in range(3)])
+        state = dl.state_dict()
+        assert state["batches_consumed"] == 3
+        dl2 = paddle.io.DataLoader(ds, batch_size=2)  # "relaunched" process
+        dl2.set_state_dict(state)
+        rest = self._ids(list(dl2))
+        assert seen + rest == ref, "no batch may be replayed or skipped"
+
+    def test_shuffled_resume_replays_the_same_order(self):
+        ds = self._ds()
+        paddle.seed(11)
+        ref = self._ids(list(paddle.io.DataLoader(ds, batch_size=2, shuffle=True)))
+        paddle.seed(11)
+        dl = paddle.io.DataLoader(ds, batch_size=2, shuffle=True)
+        it = iter(dl)
+        seen = self._ids([next(it) for _ in range(4)])
+        state = dl.state_dict()
+        paddle.seed(999)  # the restarted process seeds differently...
+        dl2 = paddle.io.DataLoader(ds, batch_size=2, shuffle=True)
+        dl2.set_state_dict(state)  # ...but the snapshot restores the epoch key
+        rest = self._ids(list(dl2))
+        assert seen + rest == ref
+
+    def test_threaded_prefetch_counts_consumed_not_produced(self):
+        ds = self._ds(16)
+        dl = paddle.io.DataLoader(ds, batch_size=2, num_workers=2,
+                                  use_shared_memory=False)
+        it = iter(dl)
+        next(it); next(it)
+        time.sleep(0.2)  # let prefetch run ahead of the consumer
+        state = dl.state_dict()
+        assert state["batches_consumed"] == 2, \
+            "state must track the consumer, not the prefetch thread"
+        dl2 = paddle.io.DataLoader(ds, batch_size=2)
+        dl2.set_state_dict(state)
+        assert self._ids(list(dl2)) == self._ids(
+            list(paddle.io.DataLoader(ds, batch_size=2)))[2:]
+
+    def test_epoch_rollover_resets_position(self):
+        ds = self._ds(8)
+        dl = paddle.io.DataLoader(ds, batch_size=2)
+        list(dl); list(dl)
+        st = dl.state_dict()
+        assert st["epoch"] == 2 and st["batches_consumed"] == 0
+
+    def test_iterable_dataset_resume(self):
+        class Stream(paddle.io.IterableDataset):
+            def __iter__(self):
+                return iter(np.arange(12, dtype=np.float32).reshape(12, 1))
+
+        def ids(batches):  # iterable mode collates to a bare tensor batch
+            return [np.asarray(b).astype(int)[:, 0].tolist() for b in batches]
+
+        ref = ids(list(paddle.io.DataLoader(Stream(), batch_size=2)))
+        dl = paddle.io.DataLoader(Stream(), batch_size=2)
+        it = iter(dl)
+        seen = ids([next(it) for _ in range(2)])
+        dl2 = paddle.io.DataLoader(Stream(), batch_size=2)
+        dl2.set_state_dict(dl.state_dict())
+        assert seen + ids(list(dl2)) == ref
+
+    def test_distributed_sampler_state_roundtrip(self):
+        ds = self._ds(16)
+        samp = paddle.io.DistributedBatchSampler(
+            ds, batch_size=2, num_replicas=2, rank=0, shuffle=True)
+        samp.set_epoch(5)
+        dl = paddle.io.DataLoader(ds, batch_sampler=samp)
+        state = dl.state_dict()
+        assert state["sampler"] == {"epoch": 5}
+        samp2 = paddle.io.DistributedBatchSampler(
+            ds, batch_size=2, num_replicas=2, rank=0, shuffle=True)
+        dl2 = paddle.io.DataLoader(ds, batch_sampler=samp2)
+        dl2.set_state_dict(state)
+        assert samp2.epoch == 5
+        assert [list(b) for b in samp2] == [list(b) for b in samp]
+
+    def test_manifest_carries_data_state(self, tmp_path):
+        ds = self._ds(12)
+        dl = paddle.io.DataLoader(ds, batch_size=2)
+        it = iter(dl)
+        next(it); next(it)
+        path = ckpt.save_checkpoint(_state(), str(tmp_path), step=4,
+                                    data_loader=dl)
+        man = ckpt.read_commit_manifest(path)
+        assert man["format_version"] == ckpt.MANIFEST_VERSION == 2
+        assert man["data_state"]["batches_consumed"] == 2
+        dl2 = paddle.io.DataLoader(ds, batch_size=2)
+        dst = _state(0.0)
+        assert ckpt.load_latest(dst, str(tmp_path), data_loader=dl2) == 4
+        ref = self._ids(list(paddle.io.DataLoader(ds, batch_size=2)))
+        assert self._ids(list(dl2)) == ref[2:]
+
+
+# ------------------------------------ manifest back-compat (PR 2 satellite)
+
+class TestManifestCompat:
+    def test_v1_manifest_round_trip(self, tmp_path):
+        """A PR-1-era COMMIT (no format_version, no data_state) must still
+        read as v1 and resume — only without a data position."""
+        root = str(tmp_path)
+        path = ckpt.save_checkpoint(_state(4.0), root, step=2)
+        cf = os.path.join(path, ckpt.COMMIT_FILE)
+        with open(cf) as f:
+            man = json.load(f)
+        man.pop("format_version")
+        man.pop("data_state", None)
+        with open(cf, "w") as f:
+            json.dump(man, f)
+        got = ckpt.read_commit_manifest(path)
+        assert got["format_version"] == 1
+        dl = paddle.io.DataLoader([(np.zeros((2,), np.float32),)
+                                   for _ in range(4)], batch_size=2)
+        dst = _state(0.0)
+        assert ckpt.load_latest(dst, root, data_loader=dl) == 2
+        np.testing.assert_allclose(dst["w"].numpy(), np.full((4,), 4.0))
+        assert dl._resume_skip == 0, "v1 has no data position to restore"
+
+    def test_newer_version_still_reads(self, tmp_path):
+        root = str(tmp_path)
+        path = ckpt.save_checkpoint(_state(1.0), root, step=1)
+        cf = os.path.join(path, ckpt.COMMIT_FILE)
+        with open(cf) as f:
+            man = json.load(f)
+        man["format_version"] = 99
+        with open(cf, "w") as f:
+            json.dump(man, f)
+        assert ckpt.read_commit_manifest(path)["format_version"] == 99
+        dst = _state(0.0)
+        assert ckpt.load_latest(dst, root) == 1  # known fields still honored
+
+
+# ------------------------------------------ cluster fault domain end-to-end
+
+class TestGangRestart:
+    @pytest.mark.slow
+    def test_collective_hang_watchdog_gang_restart_exact_resume(self, tmp_path):
+        """The PR-2 acceptance test: both ranks hang in an injected
+        collective.hang, the watchdog detects it within
+        FLAGS_collective_timeout_sec and exits 75, the controller
+        gang-restarts ALL ranks, and the resumed run consumes the exact
+        next batch — no replay, no skip, no manual intervention."""
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import os, sys\n"
+            "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+            "os.environ.pop('PALLAS_AXON_POOL_IPS', None)\n"
+            f"sys.path.insert(0, {REPO!r})\n"
+            "import numpy as np\n"
+            "import paddle_tpu as paddle\n"
+            "from paddle_tpu import fault\n"
+            "from paddle_tpu.distributed import checkpoint as ckpt\n"
+            "from paddle_tpu.distributed import collective as dist\n"
+            "rank = os.environ.get('PADDLE_TRAINER_ID', '0')\n"
+            "life = int(os.environ.get('PADDLE_RESTART_NUM', '0'))\n"
+            "out = os.environ['OUT_DIR']\n"
+            "root = os.path.join(out, 'ckpt_rank' + rank)\n"
+            "paddle.seed(5)\n"
+            "n = 16\n"
+            "ds = paddle.io.TensorDataset([paddle.to_tensor("
+            "np.arange(n, dtype=np.float32).reshape(n, 1))])\n"
+            "dl = paddle.io.DataLoader(ds, batch_size=2, shuffle=True)\n"
+            "sd = {'w': paddle.to_tensor(np.ones(4, np.float32))}\n"
+            "start = ckpt.load_latest(sd, root, data_loader=dl) or 0\n"
+            "step = start\n"
+            "for batch in dl:\n"
+            "    ids = batch[0].numpy()[:, 0].astype(int).tolist()\n"
+            "    step += 1\n"
+            "    ckpt.save_checkpoint(sd, root, step, keep_last_n=2,"
+            " data_loader=dl)\n"
+            "    with open(out + '/consumed.' + rank, 'a') as f:\n"
+            "        f.write(' '.join(map(str, ids)) + '\\n')\n"
+            "    if life == 0 and step == 4:\n"
+            "        fault.arm('collective.hang:1')\n"
+            "        t = paddle.to_tensor(np.ones(2, np.float32))\n"
+            "        dist.all_reduce(t).wait()  # hangs; watchdog exits 75\n"
+            "        raise SystemExit('unreachable: watchdog never fired')\n"
+            "open(out + '/done.' + rank + '.' + str(life), 'w')"
+            ".write(str(step))\n"
+        )
+        env = _env()
+        env["OUT_DIR"] = str(tmp_path)
+        env["JAX_PLATFORMS"] = "cpu"
+        # hang "forever" (60s) relative to the 3s watchdog deadline
+        env["FLAGS_fault_hang_sec"] = "60"
+        env["FLAGS_collective_timeout_sec"] = "3"
+        r = subprocess.run(
+            LAUNCH + ["--log_dir", str(tmp_path / "log"),
+                      "--nproc_per_node", "2",
+                      "--max_restarts", "2", "--restart_backoff", "0.1",
+                      "--stop_grace", "8", str(script)],
+            env=env, cwd=REPO, timeout=540,
+            capture_output=True, text=True,
+        )
+        assert r.returncode == 0, r.stderr[-4000:]
+        assert "requested a gang restart" in r.stderr
+        for rank in ("0", "1"):
+            assert (tmp_path / f"done.{rank}.1").exists(), \
+                f"rank {rank} life 1 never finished: {r.stderr[-2000:]}"
+            assert not (tmp_path / f"done.{rank}.0").exists(), \
+                f"rank {rank} life 0 should have died in the hang"
+            lines = (tmp_path / f"consumed.{rank}").read_text().splitlines()
+            assert len(lines) == 8, f"rank {rank}: {lines}"
+            flat = [int(x) for ln in lines for x in ln.split()]
+            assert sorted(flat) == list(range(16)), \
+                f"rank {rank} replayed or skipped samples: {flat}"
+
+    @pytest.mark.slow
+    def test_heartbeat_loss_exhausted_budget_aborts_with_diagnostic(self, tmp_path):
+        """A trainer that stops heartbeating with --max_restarts 0: the
+        controller must tear the gang down and abort cleanly, naming the
+        stale rank — not hang until an external timeout."""
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import json, os, time\n"
+            "d = os.environ['PADDLE_HEARTBEAT_DIR']\n"
+            "rank = os.environ['PADDLE_TRAINER_ID']\n"
+            "p = os.path.join(d, 'hb_' + rank + '.json')\n"
+            "for seq in range(1, 4):\n"
+            "    tmp = p + '.tmp.w'\n"
+            "    with open(tmp, 'w') as f:\n"
+            "        json.dump({'seq': seq, 'step': seq, 'status': 'RUNNING',"
+            " 'pid': os.getpid()}, f)\n"
+            "    os.replace(tmp, p)\n"
+            "    time.sleep(0.2)\n"
+            "time.sleep(120)  # hung: no more beats\n"
+        )
+        t0 = time.time()
+        r = subprocess.run(
+            LAUNCH + ["--log_dir", str(tmp_path / "log"),
+                      "--heartbeat_interval", "0.2",
+                      "--heartbeat_timeout", "1.5",
+                      "--max_restarts", "0", "--stop_grace", "2",
+                      str(script)],
+            env=_env(), cwd=REPO, timeout=120,
+            capture_output=True, text=True,
+        )
+        elapsed = time.time() - t0
+        assert r.returncode == fault.RESTART_EXIT_CODE, (r.returncode, r.stderr)
+        assert "heartbeat stale" in r.stderr
+        assert "giving up" in r.stderr
+        assert elapsed < 60, "controller must not wait out the hung sleep"

@@ -45,3 +45,39 @@ def test_thread_fallback_still_works():
     loader = DataLoader(_DS(8), batch_size=4, num_workers=2, use_shared_memory=False)
     out = [y.numpy().tolist() for _, y in loader]
     assert out == [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+
+def test_thread_worker_error_propagates_promptly():
+    """A dying prefetch thread must poison-pill the queue — the ORIGINAL
+    exception surfaces at the consumer instead of a silent early epoch end."""
+
+    class Bad(_DS):
+        def __getitem__(self, i):
+            if i == 5:
+                raise ValueError("boom at 5")
+            return super().__getitem__(i)
+
+    import pytest
+
+    loader = DataLoader(Bad(16), batch_size=2, num_workers=2, use_shared_memory=False)
+    consumed = 0
+    with pytest.raises(ValueError, match="boom at 5"):
+        for _ in loader:
+            consumed += 1
+    assert consumed < 8, "the epoch must not look complete after the crash"
+
+
+def test_thread_worker_injected_fault_propagates():
+    # the registered dataloader.next fault fires INSIDE the prefetch
+    # thread — it must cross the queue with its type intact
+    from paddle_tpu import fault
+
+    fault.arm("dataloader.next:1")
+    try:
+        loader = DataLoader(_DS(8), batch_size=2, num_workers=2, use_shared_memory=False)
+        import pytest
+
+        with pytest.raises(fault.InjectedFault):
+            list(loader)
+    finally:
+        fault.disarm()

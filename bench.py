@@ -56,15 +56,40 @@ def _on_tpu():
 
 
 def _time_steps(step_fn, ids, steps):
+    """Returns (measurement window seconds, time_to_first_step seconds).
+    The first-step time includes trace+compile — the cold-start cost the
+    compile cache (PADDLE_COMPILE_CACHE_DIR) is meant to kill."""
+    t_start = time.perf_counter()
     loss = step_fn(*ids)
     loss.numpy()
+    t_first = time.perf_counter() - t_start
     step_fn(*ids).numpy()  # second call: cached-executable path
     t0 = time.perf_counter()
     last = None
     for _ in range(steps):
         last = step_fn(*ids)
     last.numpy()
-    return time.perf_counter() - t0
+    return time.perf_counter() - t0, t_first
+
+
+def _cache_probe():
+    """Compile-cache counters snapshot; subtract two probes for a per-config
+    delta (disk hits vs fresh XLA compiles, AOT snapshot hits/misses)."""
+    from paddle_tpu import jit
+
+    info = jit.cache_info()
+    p, a = info["persistent"], info["aot"]
+    return {
+        "disk_hits": p["disk_hits"],
+        "fresh_compiles": p["misses"],
+        "aot_hits": a["hits"],
+        "aot_misses": a["misses"],
+    }
+
+
+def _cache_delta(before):
+    after = _cache_probe()
+    return {k: after[k] - before[k] for k in before}
 
 
 # ---------------------------------------------------------------------------
@@ -127,7 +152,8 @@ def bench_llama(deep=False):
 
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seqlen)).astype(np.int32))
-    dt = _time_steps(train_step, (ids,), steps)
+    cc0 = _cache_probe()
+    dt, t_first = _time_steps(train_step, (ids,), steps)
 
     tok_s = batch * seqlen * steps / dt
     mfu = 6.0 * n_params * tok_s / _chip_peak_flops()
@@ -137,6 +163,8 @@ def bench_llama(deep=False):
         "unit": "tokens/s",
         "vs_baseline": round(mfu / A100_MFU_BAR, 3),
         "mfu": round(mfu, 4),
+        "time_to_first_step_s": round(t_first, 3),
+        "compile_cache": _cache_delta(cc0),
         "params": n_params,
         "proxy": "640M wide-6-layer single-chip proxy for config 4 (Llama-7B TP=8)"
         if not deep
@@ -185,9 +213,13 @@ def bench_resnet50():
     y = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype(np.int64))
     # median of 3 measurement windows: the shared chip shows occasional
     # multi-second stalls that would otherwise sink one whole window
+    cc0 = _cache_probe()
     rates = []
+    t_first = None
     for _ in range(3 if on_tpu else 1):
-        dt = _time_steps(train_step, (x, y), steps)
+        dt, tf = _time_steps(train_step, (x, y), steps)
+        if t_first is None:
+            t_first = tf
         rates.append(batch * steps / dt)
     img_s = sorted(rates)[len(rates) // 2]
     # the raw img/s ratio conflates chip peak (v5e 197 vs A100 312 TFLOPs);
@@ -199,6 +231,8 @@ def bench_resnet50():
         "unit": "images/s",
         "vs_baseline": round(img_s / A100_RESNET50_IMG_S, 3),
         "vs_a100_peak_normalized": round(img_s / (A100_RESNET50_IMG_S * peak_ratio), 3),
+        "time_to_first_step_s": round(t_first, 3),
+        "compile_cache": _cache_delta(cc0),
         "note": "A100 AMP bar ~2500 img/s (BASELINE.md config 2)",
     }
 
@@ -232,7 +266,12 @@ def bench_lenet_eager():
         opt.clear_grad()
         return loss
 
-    for _ in range(3):
+    cc0 = _cache_probe()
+    t0 = time.perf_counter()
+    step().numpy()
+    t_first = time.perf_counter() - t0
+    cc_delta = _cache_delta(cc0)
+    for _ in range(2):
         step()
     n = 30 if _on_tpu() else 10
     t0 = time.perf_counter()
@@ -244,6 +283,8 @@ def bench_lenet_eager():
         "metric": "lenet_eager_steps_per_sec",
         "value": round(n / dt, 1),
         "unit": "steps/s",
+        "time_to_first_step_s": round(t_first, 3),
+        "compile_cache": cc_delta,
         "note": "dygraph (no to_static); cached per-op executables, 5.9x vs retrace",
     }
 
@@ -276,6 +317,14 @@ def bench_llama_decode():
     ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, prompt)).astype(np.int32))
     iters = 3 if on_tpu else 1
 
+    # cold-start serving latency: prompt-to-first-full-response including
+    # trace+compile (or AOT snapshot load, with the cache dir set)
+    cc0 = _cache_probe()
+    t0 = time.perf_counter()
+    model.generate(ids, max_new_tokens=new_toks).numpy()
+    t_first = time.perf_counter() - t0
+    cc_delta = _cache_delta(cc0)
+
     def run(**kw):
         model.generate(ids, max_new_tokens=new_toks, **kw).numpy()  # compile
         rates = []
@@ -298,6 +347,9 @@ def bench_llama_decode():
         "sampled_tokens_per_sec": round(tok_s_sampled, 1),
         "sampled_vs_greedy": round(tok_s_sampled / tok_s, 3),
         "compiles": model._gen_fns["greedy"].trace_count,
+        "aot_hits": model._gen_fns["greedy"].aot_hits,
+        "time_to_first_step_s": round(t_first, 3),
+        "compile_cache": cc_delta,
         "note": "1.3B-class model, batch 8, static-KV compiled decode step; "
         "sampling (top-k/top-p + categorical) runs inside the compiled step",
     }
@@ -331,8 +383,12 @@ def bench_moe():
 
     rng = np.random.RandomState(0)
     x = paddle.to_tensor(rng.randn(batch, seq, d_model).astype(np.float32))
+    cc0 = _cache_probe()
+    t0 = time.perf_counter()
     out = step(x)
     out[0].numpy()
+    t_first = time.perf_counter() - t0
+    cc_delta = _cache_delta(cc0)
     rates = []
     for _ in range(3 if on_tpu else 1):  # median-of-3, same as the other legs
         t0 = time.perf_counter()
@@ -345,6 +401,8 @@ def bench_moe():
         "metric": "moe_gshard_tokens_per_sec",
         "value": round(sorted(rates)[len(rates) // 2], 1),
         "unit": "tokens/s",
+        "time_to_first_step_s": round(t_first, 3),
+        "compile_cache": cc_delta,
         "aux_loss": round(aux, 4),
         "dropped_fraction": round(dropf, 4),
         "note": f"{experts}-expert top-2 GShard FFN {d_model}->{d_hidden}, fwd+bwd+opt",
@@ -394,7 +452,8 @@ def bench_bert():
     mask = paddle.to_tensor(mask_np)
     st = paddle.to_tensor(rng.randint(0, seqlen // 2, (batch,)).astype(np.int64))
     en = paddle.to_tensor(rng.randint(0, seqlen // 2, (batch,)).astype(np.int64))
-    dt = _time_steps(train_step, (ids, mask, st, en), steps)
+    cc0 = _cache_probe()
+    dt, t_first = _time_steps(train_step, (ids, mask, st, en), steps)
     ex_s = batch * steps / dt
     mfu = 6.0 * n_params * (batch * seqlen * steps / dt) / _chip_peak_flops()
     return {
@@ -403,6 +462,8 @@ def bench_bert():
         "unit": "examples/s",
         "vs_baseline": round(mfu / A100_MFU_BAR, 3),
         "mfu": round(mfu, 4),
+        "time_to_first_step_s": round(t_first, 3),
+        "compile_cache": _cache_delta(cc0),
         "params": n_params,
     }
 
@@ -640,6 +701,13 @@ def main():
         configs["loss_parity"] = parity_gates()
     except Exception as e:
         configs["loss_parity"] = {"ok": False, "error": f"{type(e).__name__}: {e}"[:300]}
+
+    try:  # end-of-run cache totals to stderr (stdout stays one JSON line)
+        from paddle_tpu.jit import cache_report
+
+        print(cache_report(), file=sys.stderr)
+    except Exception:
+        pass
 
     if "--all" in sys.argv:
         print(json.dumps(headline))

@@ -19,10 +19,13 @@ fi
 if [ "$MODE" = "chaos" ]; then
   echo "== chaos suite (slow fault-domain drills, hard 20min cap) =="
   # the drills themselves assert the in-process watchdog fires; the
-  # timeout(1) wrapper is the belt-and-braces layer above it
+  # timeout(1) wrapper is the belt-and-braces layer above it.
+  # test_compile_cache.py's slow tests cover the cold-start acceptance:
+  # warm gang restart resumes inside the tightened first-step deadline,
+  # and a fresh process pays 0 fresh XLA compiles from the warm cache.
   timeout -k 30 1200 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
-      python -m pytest tests/test_fault_tolerance.py -q -m slow \
-      -p no:cacheprovider
+      python -m pytest tests/test_fault_tolerance.py tests/test_compile_cache.py \
+      -q -m slow -p no:cacheprovider
   echo "CHAOS OK"
   exit 0
 fi
@@ -43,6 +46,13 @@ PYTEST_ARGS=()
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m pytest tests/ -q "${PYTEST_ARGS[@]+${PYTEST_ARGS[@]}}"
+
+echo "== compile-cache cold-start proof (subprocess AOT round-trip, tmpdir cache) =="
+# a fresh process must bind the previous process's snapshot: 0 traces,
+# 0 fresh XLA compiles (ISSUE 3 acceptance; runs in every tier)
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python -m pytest "tests/test_compile_cache.py::test_second_process_train_step_zero_compiles" \
+    -q -p no:cacheprovider
 
 if [ "$MODE" != "fast" ]; then
   echo "== bench smoke (CPU) =="

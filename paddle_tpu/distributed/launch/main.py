@@ -47,6 +47,19 @@ import time
 RESTART_EXIT_CODE = 75
 
 
+def _cache_has_entries(d):
+    """Warm-start detection: does the compile cache dir hold anything yet?"""
+    if not d:
+        return False
+    try:
+        for _root, _dirs, files in os.walk(d):
+            if files:
+                return True
+    except OSError:
+        pass
+    return False
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("", 0))
@@ -83,6 +96,24 @@ def parse_args(argv=None):
         "--ckpt_dir", type=str, default=os.environ.get("PADDLE_CKPT_DIR", ""),
         help="checkpoint root exported to trainers as PADDLE_CKPT_DIR; a "
         "relaunched trainer auto-resumes via distributed.checkpoint.load_latest",
+    )
+    p.add_argument(
+        "--compile_cache_dir", type=str,
+        default=os.environ.get("PADDLE_COMPILE_CACHE_DIR", ""),
+        help="persistent compilation cache root exported to trainers as "
+        "PADDLE_COMPILE_CACHE_DIR; it outlives gang teardowns, so relaunched "
+        "ranks reload XLA binaries + AOT snapshots instead of recompiling",
+    )
+    p.add_argument(
+        "--first_step_timeout", type=float, default=0.0,
+        help="gang-restart when a trainer has not finished step 1 within this "
+        "many seconds of spawn (0 disables); scaled by --warm_start_factor "
+        "when the compile cache already has entries",
+    )
+    p.add_argument(
+        "--warm_start_factor", type=float, default=0.25,
+        help="fraction of --first_step_timeout granted on a warm compile "
+        "cache (a relaunch that skips compilation must reach step 1 sooner)",
     )
     p.add_argument("--host", type=str, default="")
     p.add_argument("--hb_interval", type=float, default=2.0, help="node-level heartbeat period (s) in the multi-node TCPStore")
@@ -207,6 +238,11 @@ class CollectiveController:
         # trainer-level (heartbeat-file) liveness for the local gang
         self.hb_dir = os.path.join(args.log_dir, "heartbeat")
         self._trainer_hb = {}  # rank -> (seq, local time of last change)
+        # cold-start accounting: when the current gang was spawned, whether
+        # the compile cache had entries then, and which ranks reached step 1
+        self._spawn_time = time.time()
+        self._cache_warm = False
+        self._first_step = {}  # rank -> local time of first step>=1 heartbeat
 
     # -- store / rendezvous ------------------------------------------------
     def _connect_store(self):
@@ -283,6 +319,19 @@ class CollectiveController:
         extra["PADDLE_RESTART_NUM"] = str(self._restarts)
         if args.ckpt_dir:
             extra["PADDLE_CKPT_DIR"] = args.ckpt_dir
+        # warm-start contract: the compile cache dir outlives gang teardowns,
+        # so a relaunched rank reloads XLA binaries + AOT snapshots instead
+        # of recompiling.  FLAGS_* env overrides ride along explicitly — the
+        # relaunched gang must run under the SAME flags it crashed under
+        # (and the snapshot fingerprint would reject mismatched entries).
+        if args.compile_cache_dir:
+            extra["PADDLE_COMPILE_CACHE_DIR"] = args.compile_cache_dir
+        for k, v in os.environ.items():
+            if k.startswith("FLAGS_") or k == "PADDLE_COMPILE_CACHE_DIR":
+                extra.setdefault(k, v)
+        self._cache_warm = _cache_has_entries(args.compile_cache_dir)
+        self._spawn_time = time.time()
+        self._first_step = {}
         # liveness contract: trainers beat into hb_dir; a fresh gang must
         # never read a dead life's heartbeat/ABORT state
         from ...fault import heartbeat as _hbmod
@@ -418,9 +467,43 @@ class CollectiveController:
                 file=sys.stderr,
             )
             return RESTART_EXIT_CODE
+        hbs = _hbmod.scan_heartbeats(self.hb_dir)
+        # time-to-first-step: the cold-start metric this controller manages.
+        # Logged once per rank per gang; the warm/cold tag ties it to the
+        # compile cache state at spawn.
+        for rank, payload in sorted(hbs.items()):
+            step = payload.get("step") or 0
+            if rank not in self._first_step and step >= 1:
+                self._first_step[rank] = now
+                print(
+                    f"[launch] rank {rank} time_to_first_step="
+                    f"{now - self._spawn_time:.2f}s "
+                    f"({'warm' if self._cache_warm else 'cold'} compile cache)",
+                    file=sys.stderr,
+                )
+        if self.args.first_step_timeout > 0:
+            deadline = self.args.first_step_timeout * (
+                self.args.warm_start_factor if self._cache_warm else 1.0
+            )
+            if (
+                len(self._first_step) < len(self.containers)
+                and now - self._spawn_time > deadline
+            ):
+                missing = [
+                    c.rank for c in self.containers
+                    if c.rank not in self._first_step
+                ]
+                print(
+                    f"[launch] ranks {missing} did not reach step 1 within "
+                    f"{deadline:.1f}s "
+                    f"({'warm' if self._cache_warm else 'cold'} deadline); "
+                    "gang restart",
+                    file=sys.stderr,
+                )
+                return RESTART_EXIT_CODE
         if self.args.heartbeat_timeout <= 0:
             return None
-        for rank, payload in _hbmod.scan_heartbeats(self.hb_dir).items():
+        for rank, payload in hbs.items():
             seq = payload.get("seq", 0)
             last = self._trainer_hb.get(rank)
             if last is None or seq != last[0]:

@@ -390,6 +390,8 @@ def set_flags(flags: dict):
             raise KeyError(f"Unknown flag {k!r}")
         typ = _FLAG_DEFS[k][0]
         _flags[k] = _parse_flag(typ, v) if isinstance(v, str) and typ is not str else typ(v)
+    if "FLAGS_compile_cache_dir" in flags:
+        setup_compile_cache()
 
 
 def flag(name):
@@ -403,3 +405,99 @@ define_flag("FLAGS_use_stride_kernel", False, "compat only")
 define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92, "compat only; XLA preallocation")
 define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "compat only; GC by refcount")
 define_flag("FLAGS_log_level", 0, "VLOG level for python-side logging")
+define_flag(
+    "FLAGS_compile_cache_dir",
+    os.environ.get("PADDLE_COMPILE_CACHE_DIR", ""),
+    "persistent compilation cache root: XLA binaries (jax persistent cache) "
+    "and AOT executable snapshots survive the process, so restarts and "
+    "serving cold starts skip recompilation; empty disables",
+)
+define_flag(
+    "FLAGS_eager_cache_max_entries", 4096,
+    "LRU bound on the eager dispatch executable cache (ops/dispatch.py)",
+)
+
+
+# ---------------------------------------------------------------------------
+# Persistent compilation cache (tentpole of the compile-once cold start):
+# every XLA compile — eager op executables, @to_static train steps, the
+# inference Predictor — goes through jax's disk cache when a dir is set, so
+# a (program, topology, version) pays its compile bill once per machine,
+# not once per process.  The AOT snapshot tier (jit/cache.py) sits above
+# this and additionally skips trace+lower.
+# ---------------------------------------------------------------------------
+
+_compile_cache_stats = {"disk_hits": 0, "requests": 0}
+_cc_listener_installed = False
+
+
+def _install_cc_listener():
+    """Count jax's persistent-cache traffic: requests == compile calls that
+    consulted the disk cache; disk_hits == loads that skipped XLA entirely.
+    requests - disk_hits is therefore the fresh-XLA-compile count."""
+    global _cc_listener_installed
+    if _cc_listener_installed:
+        return
+    try:
+        from jax._src import monitoring as _mon
+    except ImportError:  # jax moved the module; stats stay zero
+        return
+
+    def _listener(event, **kw):
+        if event == "/jax/compilation_cache/cache_hits":
+            _compile_cache_stats["disk_hits"] += 1
+        elif event == "/jax/compilation_cache/compile_requests_use_cache":
+            _compile_cache_stats["requests"] += 1
+
+    _mon.register_event_listener(_listener)
+    _cc_listener_installed = True
+
+
+def compile_cache_stats():
+    d = _flags["FLAGS_compile_cache_dir"]
+    out = dict(_compile_cache_stats)
+    out["dir"] = d
+    out["misses"] = out["requests"] - out["disk_hits"]
+    entries = 0
+    size = 0
+    if d:
+        try:
+            for name in os.listdir(d):
+                if name.endswith("-cache"):
+                    entries += 1
+                    try:
+                        size += os.path.getsize(os.path.join(d, name))
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+    out["entries"] = entries
+    out["bytes"] = size
+    return out
+
+
+def setup_compile_cache(path=None):
+    """Point jax's persistent compilation cache at FLAGS_compile_cache_dir
+    (or `path`, which also updates the flag).  Idempotent; re-invoked by
+    set_flags when the flag changes.  Empty dir disables the disk cache."""
+    if path is not None:
+        _flags["FLAGS_compile_cache_dir"] = str(path)
+    d = _flags["FLAGS_compile_cache_dir"]
+    if not d:
+        try:
+            jax.config.update("jax_compilation_cache_dir", None)
+        except (AttributeError, ValueError):
+            pass
+        return None
+    os.makedirs(d, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", d)
+    # cache every executable: the default thresholds skip small/fast
+    # compiles, but cold-start latency is exactly the sum of those
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    _install_cc_listener()
+    return d
+
+
+_install_cc_listener()
+setup_compile_cache()

@@ -98,7 +98,10 @@ class Predictor:
 
         with open(path + ".stablehlo", "rb") as f:
             self._exported = jexport.deserialize(f.read())
-        self._call = self._exported.call
+        # jit the exported call so its compile goes through jax's compilation
+        # cache — with FLAGS_compile_cache_dir set, a restarted server loads
+        # the XLA binary from disk instead of recompiling the program
+        self._call = jax.jit(self._exported.call)
 
     def run(self, inputs):
         arrays = [
@@ -141,6 +144,16 @@ class GenerationPredictor:
             Tensor(ids), max_new_tokens=n, temperature=float(temperature)
         )
         return np.asarray(out.numpy())
+
+    def warmup(self, batch_size=1, prompt_len=8, max_new_tokens=None, temperature=0.0):
+        """Compile (or AOT-load, with FLAGS_compile_cache_dir set) the
+        prefill + decode executables for one serving bucket before traffic
+        arrives, so the first request pays no cold-start compile.  Runs a
+        dummy generate on zero ids — model weights are read-only in decode,
+        nothing is mutated."""
+        ids = np.zeros((int(batch_size), int(prompt_len)), np.int32)
+        self.generate(ids, max_new_tokens=max_new_tokens, temperature=temperature)
+        return self
 
 
 def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True):

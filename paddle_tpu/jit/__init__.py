@@ -25,6 +25,9 @@ program cache keyed on InputSpec.
 from __future__ import annotations
 
 import functools
+import logging
+import os
+import time
 import weakref
 
 import numpy as np
@@ -32,6 +35,10 @@ import jax
 
 from ..framework import core as _core
 from ..tensor import Tensor
+from . import cache as _snap
+from .cache import cache_info, cache_report  # noqa: F401  (public API)
+
+_logger = logging.getLogger("paddle_tpu")
 
 _MISS = object()
 
@@ -146,7 +153,7 @@ def _struct_signature(obj):
 
 class _CompiledEntry:
     __slots__ = (
-        "jitted", "state_in", "rw_flags", "state_out", "none_out",
+        "jitted", "compiled", "state_in", "rw_flags", "state_out", "none_out",
         "out_template", "boxes", "nan_names",
     )
 
@@ -162,11 +169,16 @@ class StaticFunction:
         # number of trace+compile events — tests assert the compiled decode
         # path really is one executable for N tokens
         self.trace_count = 0
+        # number of AOT snapshot loads (trace+lower skipped entirely)
+        self.aot_hits = 0
         functools.update_wrapper(self, fn)
 
     # -- tracing --------------------------------------------------------
-    def _trace(self, args, kwargs):
-        self.trace_count += 1
+    def _discover(self, args, kwargs):
+        """Phase 1: run fn under jax.eval_shape with slot interception to
+        learn the implicit state layout.  Cheap (no compute, no compile) —
+        it runs even on the AOT snapshot path, because state slots are live
+        Python objects a serialized artifact cannot name."""
         fn = self._fn
         in_tensors = []
         args_tpl = _flatten_structure((args, kwargs), in_tensors)
@@ -174,7 +186,6 @@ class StaticFunction:
         in_flags = [t.stop_gradient for t in in_tensors]
         del in_tensors  # don't capture the first batch in closures
 
-        # ---- phase 1: discover state reads/writes (no compute)
         discover = _Trace("discover")
 
         def discover_wrapper(arrs):
@@ -206,6 +217,28 @@ class StaticFunction:
         state_in = list(discover.reads.values())
         write_keys = set(discover.writes.keys())
         rw_flags = [(id(t), k) in write_keys for (t, k) in state_in]
+        return discover, args_tpl, in_structs, in_flags, state_in, rw_flags
+
+    def _state_avals(self, state_in, rw_flags):
+        """Abstract state layout, part of the snapshot identity (a model
+        with different parameter shapes must not bind another's program).
+        None when any slot is unreadable (stale grads): no snapshot I/O."""
+        out = []
+        for (t, kind), rw in zip(state_in, rw_flags):
+            v = t._raw if kind == "data" else t._grad_raw
+            if v is None:
+                return None
+            out.append((tuple(v.shape), str(v.dtype), bool(rw), kind))
+        return tuple(out)
+
+    def _trace(self, key, args, kwargs, bundle=None):
+        self.trace_count += 1
+        _snap.STATS["traces"] += 1
+        t0 = time.perf_counter()
+        fn = self._fn
+        if bundle is None:
+            bundle = self._discover(args, kwargs)
+        discover, args_tpl, in_structs, in_flags, state_in, rw_flags = bundle
 
         # ---- phase 2: the jitted runner
         boxes = {}
@@ -263,11 +296,142 @@ class StaticFunction:
         entry.state_in = state_in
         entry.rw_flags = rw_flags
         entry.jitted = jax.jit(runner, donate_argnums=(2,) if self._donate else ())
+        entry.compiled = None
         entry.state_out = None
         entry.none_out = None
         entry.out_template = None
         entry.boxes = boxes
+        _snap.STATS["trace_ms"] += (time.perf_counter() - t0) * 1000
+        self._maybe_snapshot(entry, key, in_structs, discover)
         return entry
+
+    # -- AOT snapshot tier ----------------------------------------------
+    def _maybe_snapshot(self, entry, key, in_structs, discover):
+        """Serialize this trace's lowered program (jax.export) + state-layout
+        metadata so a FRESH process can skip trace+lower entirely.  Best
+        effort: any failure leaves the in-memory entry untouched."""
+        if not _snap.enabled():
+            return
+        try:
+            from jax import export as _jexport
+
+            state_avals = self._state_avals(entry.state_in, entry.rw_flags)
+            if state_avals is None:
+                return
+            path = _snap.entry_path(self._fn, key, state_avals)
+            if path is None:
+                return
+            ro_specs, rw_specs = [], []
+            for (shape, dtype, rw, _kind) in state_avals:
+                sds = jax.ShapeDtypeStruct(shape, jax.numpy.dtype(dtype))
+                (rw_specs if rw else ro_specs).append(sds)
+            exported = _jexport.export(entry.jitted)(in_structs, ro_specs, rw_specs)
+            boxes = entry.boxes
+            if "out" not in boxes:  # export should have traced the runner
+                _snap.STATS["unsupported"] += 1
+                return
+            # persist state-slot ordering as indices into the DISCOVER write
+            # list — the one enumeration a fresh process reproduces without
+            # an execute trace.  Execute-only writes can't be indexed: skip.
+            pos = {k: i for i, k in enumerate(discover.writes.keys())}
+            s_out_idx, none_idx = [], []
+            for (t, kind) in boxes["out"]:
+                i = pos.get((id(t), kind))
+                if i is None:
+                    _snap.STATS["unsupported"] += 1
+                    return
+                s_out_idx.append(i)
+            for (t, kind) in boxes["none"]:
+                i = pos.get((id(t), kind))
+                if i is None:
+                    _snap.STATS["unsupported"] += 1
+                    return
+                none_idx.append(i)
+            meta = {
+                "s_out_idx": s_out_idx,
+                "none_idx": none_idx,
+                "n_writes": len(discover.writes),
+                "tpl": boxes["tpl"],
+                "nan_names": boxes["nan_names"],
+            }
+            _snap.save(path, _snap.fingerprint(self._fn, self._donate),
+                       bytes(exported.serialize()), meta)
+        except Exception as e:  # snapshotting must never break the step
+            _snap.STATS["unsupported"] += 1
+            _logger.info("compile cache: could not snapshot %s: %s",
+                         getattr(self, "__name__", "fn"), e)
+
+    def _load_snapshot(self, key, bundle):
+        """Bind a persisted program to this process's live state.  Returns a
+        ready entry (state_out/template resolved from metadata — no execute
+        trace needed) or None to fall back to a fresh trace."""
+        discover, args_tpl, in_structs, in_flags, state_in, rw_flags = bundle
+        state_avals = self._state_avals(state_in, rw_flags)
+        if state_avals is None:
+            return None
+        path = _snap.entry_path(self._fn, key, state_avals)
+        if path is None:
+            return None
+        rec = _snap.load(path, _snap.fingerprint(self._fn, self._donate))
+        if rec is None:
+            return None
+        blob, meta = rec
+        try:
+            from jax import export as _jexport
+
+            writes = list(discover.writes.values())
+            if meta["n_writes"] != len(writes):
+                raise ValueError(
+                    f"state layout drift: {meta['n_writes']} writes at save "
+                    f"time vs {len(writes)} now"
+                )
+            exported = _jexport.deserialize(bytearray(blob))
+            entry = _CompiledEntry()
+            entry.state_in = state_in
+            entry.rw_flags = rw_flags
+            entry.jitted = jax.jit(
+                exported.call, donate_argnums=(2,) if self._donate else ()
+            )
+            entry.compiled = None
+            entry.state_out = [writes[i] for i in meta["s_out_idx"]]
+            entry.none_out = [writes[i] for i in meta["none_idx"]]
+            entry.out_template = meta["tpl"]
+            entry.nan_names = meta["nan_names"]
+            entry.boxes = {}
+            self.aot_hits += 1
+            return entry
+        except Exception as e:
+            # counted as a hit by the store before the bind failed: re-class
+            _snap.STATS["hits"] -= 1
+            _snap.STATS["corrupt"] += 1
+            _snap.STATS["misses"] += 1
+            _logger.warning("compile cache: snapshot bind failed for %s (%s); "
+                            "recompiling", path, e)
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return None
+
+    def _resolve(self, key, args, kwargs):
+        """Snapshot tier first, fresh trace second (one shared discover)."""
+        if _snap.enabled():
+            bundle = self._discover(args, kwargs)
+            entry = self._load_snapshot(key, bundle)
+            if entry is not None:
+                return entry
+            return self._trace(key, args, kwargs, bundle=bundle)
+        return self._trace(key, args, kwargs)
+
+    def warmup(self, *args, **kwargs):
+        """Resolve and COMPILE the executable for this input signature
+        without running it — parameters/optimizer state are untouched, and
+        the first real batch dispatches straight to the AOT-compiled
+        executable (paddle.jit.warmup pre-serving hook)."""
+        entry, arg_arrays, ro_vals, rw_vals = self._prepare(args, kwargs)
+        if entry.compiled is None:
+            entry.compiled = entry.jitted.lower(arg_arrays, ro_vals, rw_vals).compile()
+        return self
 
     # -- call -----------------------------------------------------------
     def _prepare(self, args, kwargs):
@@ -278,7 +442,7 @@ class StaticFunction:
         key = _struct_signature((args, tuple(sorted(kwargs.items()))))
         entry = self._cache.get(key)
         if entry is None:
-            entry = self._trace(args, kwargs)
+            entry = self._resolve(key, args, kwargs)
             self._cache[key] = entry
 
         in_tensors = []
@@ -295,7 +459,7 @@ class StaticFunction:
                 (rw_vals if rw else ro_vals).append(v)
             if not stale or attempt == 1:
                 break
-            entry = self._trace(args, kwargs)
+            entry = self._trace(key, args, kwargs)
             self._cache[key] = entry
         return entry, arg_arrays, ro_vals, rw_vals
 
@@ -304,7 +468,8 @@ class StaticFunction:
             return self._fn(*args, **kwargs)  # nested to_static: inline
         entry, arg_arrays, ro_vals, rw_vals = self._prepare(args, kwargs)
 
-        out_arrays, state_vals, nan_flags = entry.jitted(arg_arrays, ro_vals, rw_vals)
+        runner = entry.compiled if entry.compiled is not None else entry.jitted
+        out_arrays, state_vals, nan_flags = runner(arg_arrays, ro_vals, rw_vals)
 
         if entry.state_out is None:
             entry.state_out = entry.boxes["out"]
@@ -342,8 +507,14 @@ class StaticFunction:
             out_tensors.append(t)
         return _rebuild_structure(entry.out_template, out_tensors)
 
-    def clear_cache(self):
+    def clear_cache(self, persistent=False):
+        """Drop in-memory compiled entries; with persistent=True also purge
+        this function's on-disk AOT snapshots.  Returns the number of
+        persistent entries removed (0 when persistent=False)."""
         self._cache.clear()
+        if persistent:
+            return _snap.purge(self._fn)
+        return 0
 
     def lowered_text(self, *args, **kwargs):
         """Optimized-HLO text of the compiled step for the given inputs —
@@ -370,6 +541,33 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
     if function is not None:
         return wrap(function)
     return wrap
+
+
+def warmup(fns_or_dir):
+    """Pre-populate executables before the first batch.
+
+    - `warmup("/path/to/cache")`: prefetch that cache dir's AOT snapshot
+      payloads into memory so the binds triggered by the first calls are
+      memory reads, not disk reads.  Returns the number of entries staged.
+    - `warmup([(fn, args), (fn, args, kwargs), ...])`: for each
+      StaticFunction, resolve + COMPILE the executable for that input
+      signature without executing it (state untouched).  Returns the number
+      of functions warmed.
+    """
+    if isinstance(fns_or_dir, (str, os.PathLike)):
+        return _snap.prefetch(str(fns_or_dir))
+    n = 0
+    for item in fns_or_dir:
+        fn, rest = item[0], item[1:]
+        if not isinstance(fn, StaticFunction):
+            raise TypeError(
+                f"jit.warmup expects StaticFunction entries, got {type(fn).__name__}"
+            )
+        a = rest[0] if len(rest) >= 1 else ()
+        kw = rest[1] if len(rest) >= 2 else {}
+        fn.warmup(*a, **kw)
+        n += 1
+    return n
 
 
 def not_to_static(fn):

@@ -40,8 +40,27 @@ import collections as _collections
 _UNHASHABLE = object()
 _SIMPLE_TYPES = (int, float, bool, str, bytes, type(None))
 _EAGER_CACHE = _collections.OrderedDict()
-_EAGER_CACHE_CAP = 1024
+_EAGER_STATS = {"hits": 0, "misses": 0, "evictions": 0, "invalidations": 0}
 _BWD_APPLY = None
+
+
+def _eager_cache_cap():
+    """LRU bound on the executable cache (FLAGS_eager_cache_max_entries) —
+    long-running multi-model processes must not grow it without bound."""
+    try:
+        cap = int(_core.flag("FLAGS_eager_cache_max_entries"))
+    except (KeyError, TypeError, ValueError):
+        cap = 4096
+    return max(1, cap)
+
+
+def cache_stats():
+    """Eager executable cache counters for jit.cache_info()."""
+    return {
+        "entries": len(_EAGER_CACHE),
+        "capacity": _eager_cache_cap(),
+        **_EAGER_STATS,
+    }
 
 
 def _freeze(v, depth=0):
@@ -137,6 +156,7 @@ def _dispatch_salt():
 
     mesh = _mesh.get_mesh()
     if mesh is not _last_salt_mesh:
+        _EAGER_STATS["invalidations"] += len(_EAGER_CACHE)
         _EAGER_CACHE.clear()
         _last_salt_mesh = mesh
     amp = _core.active_amp()
@@ -155,11 +175,15 @@ def _dispatch_salt():
 def _cache_get(key, builder):
     entry = _EAGER_CACHE.get(key)
     if entry is None:
+        _EAGER_STATS["misses"] += 1
         entry = builder()
         _EAGER_CACHE[key] = entry
-        if len(_EAGER_CACHE) > _EAGER_CACHE_CAP:
+        cap = _eager_cache_cap()
+        while len(_EAGER_CACHE) > cap:
             _EAGER_CACHE.popitem(last=False)
+            _EAGER_STATS["evictions"] += 1
     else:
+        _EAGER_STATS["hits"] += 1
         _EAGER_CACHE.move_to_end(key)
     return entry
 

@@ -168,6 +168,14 @@ class Profiler:
         if self._step_times:
             avg = sum(self._step_times) / len(self._step_times)
             print(f"steps: {len(self._step_times)}  avg step time: {avg*1000:.3f} ms")
+        # compile caches dominate cold-start cost: surface them next to the
+        # step timing so "why was the first step slow" is answerable here
+        try:
+            from .jit import cache_report
+
+            print(cache_report())
+        except Exception:
+            pass
 
     def step_info(self, unit=None):
         if self._step_times:

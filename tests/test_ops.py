@@ -342,4 +342,6 @@ class TestEagerDispatchCache:
     def test_cache_capped(self):
         from paddle_tpu.ops import dispatch
 
-        assert len(dispatch._EAGER_CACHE) <= dispatch._EAGER_CACHE_CAP
+        stats = dispatch.cache_stats()
+        assert stats["entries"] <= stats["capacity"]
+        assert len(dispatch._EAGER_CACHE) <= dispatch._eager_cache_cap()

@@ -289,6 +289,106 @@ def bench_lenet_eager():
     }
 
 
+def bench_hapi_async():
+    """Async step pipeline (fit()'s bounded in-flight ring + device-resident
+    losses/metrics) vs the strict per-step sync fallback
+    (FLAGS_max_inflight_steps=1).  Same models, same data, same numerics —
+    only the host/device overlap differs, so steps/s isolates the cost of
+    per-step host materialization."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, profiler
+
+    on_tpu = _on_tpu()
+
+    def _run(build, data, batch, inflight):
+        paddle.set_flags({"FLAGS_max_inflight_steps": inflight})
+        paddle.seed(0)
+        model = build()
+        model.fit(data, batch_size=batch, epochs=1, verbose=0, shuffle=False)  # warmup: compile
+        profiler.reset_step_breakdown()
+        rates = []
+        for _ in range(3):  # median-of-3 windows, like the other legs
+            t0 = time.perf_counter()
+            model.fit(data, batch_size=batch, epochs=1, verbose=0, shuffle=False)
+            rates.append((len(data) // batch) / (time.perf_counter() - t0))
+        return sorted(rates)[1], profiler.step_breakdown()
+
+    def _case(build, data, batch):
+        try:
+            sync_sps, _ = _run(build, data, batch, 1)
+            async_sps, bd = _run(build, data, batch, 2)
+        finally:
+            paddle.set_flags({"FLAGS_max_inflight_steps": 2})
+        return {
+            "sync_steps_per_sec": round(sync_sps, 1),
+            "async_steps_per_sec": round(async_sps, 1),
+            "speedup": round(async_sps / sync_sps, 3),
+            "host_blocked_ms_avg": round(bd.get("host_blocked_ms_avg", 0.0), 3),
+            "dispatch_ms_avg": round(bd.get("dispatch_ms_avg", 0.0), 3),
+            "inflight_depth_max": bd.get("inflight_depth_max", 0),
+        }
+
+    rng = np.random.RandomState(0)
+
+    def build_lenet():
+        from paddle_tpu.vision.models import LeNet
+
+        net = LeNet()
+        model = paddle.Model(net)
+        model.prepare(
+            paddle.optimizer.Adam(learning_rate=1e-3, parameters=net.parameters()),
+            nn.CrossEntropyLoss(),
+            paddle.metric.Accuracy(),
+        )
+        return model
+
+    n, batch = (512, 32) if on_tpu else (64, 16)
+    lenet_data = [
+        (rng.rand(1, 28, 28).astype(np.float32), np.int64(rng.randint(0, 10)))
+        for _ in range(n)
+    ]
+    lenet = _case(build_lenet, lenet_data, batch)
+
+    from paddle_tpu.models.bert import BertConfig, BertForSequenceClassification
+
+    if on_tpu:
+        bcfg = BertConfig.bert_base(
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0
+        )
+        bn, bbatch, bseq = 128, 16, 128
+    else:
+        bcfg = BertConfig.tiny()
+        bn, bbatch, bseq = 32, 4, 64
+
+    def build_bert():
+        net = BertForSequenceClassification(bcfg, num_classes=2)
+        model = paddle.Model(net)
+        model.prepare(
+            paddle.optimizer.AdamW(learning_rate=3e-5, parameters=net.parameters()),
+            nn.CrossEntropyLoss(),
+        )
+        return model
+
+    bert_data = [
+        (
+            rng.randint(0, bcfg.vocab_size, (bseq,)).astype(np.int32),
+            np.int64(rng.randint(0, 2)),
+        )
+        for _ in range(bn)
+    ]
+    bert = _case(build_bert, bert_data, bbatch)
+
+    return {
+        "metric": "hapi_async_vs_sync_speedup",
+        "value": bert["speedup"],
+        "unit": "x",
+        "lenet": lenet,
+        "bert": bert,
+        "note": "Model.fit steps/s, FLAGS_max_inflight_steps 2 vs 1; "
+        "identical numerics (tests/test_async_pipeline.py parity test)",
+    }
+
+
 def bench_llama_decode():
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
@@ -682,6 +782,7 @@ def main():
         ("bert_base_qa", bench_bert),
         ("llama_decode", bench_llama_decode),
         ("lenet_eager", bench_lenet_eager),
+        ("hapi_async", bench_hapi_async),
         ("moe_gshard", bench_moe),
     ):
         try:

@@ -54,6 +54,16 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python -m pytest "tests/test_compile_cache.py::test_second_process_train_step_zero_compiles" \
     -q -p no:cacheprovider
 
+echo "== sync-fallback parity (FLAGS_max_inflight_steps=1) =="
+# the async step pipeline must degrade to the strict per-step loop with
+# identical behavior; fast mode re-runs the loop-adjacent suites, full
+# mode re-runs the whole tier-1 shape under the fallback
+SYNC_TESTS=(tests/)
+[ "$MODE" = "fast" ] && SYNC_TESTS=(tests/test_async_pipeline.py tests/test_hapi_fleet.py tests/test_io_workers.py)
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    FLAGS_max_inflight_steps=1 \
+    python -m pytest "${SYNC_TESTS[@]}" -q -m "not slow" -p no:cacheprovider
+
 if [ "$MODE" != "fast" ]; then
   echo "== bench smoke (CPU) =="
   env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --all

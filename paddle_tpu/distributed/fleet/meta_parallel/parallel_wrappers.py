@@ -23,6 +23,36 @@ from ....tensor import Tensor
 from ... import mesh as _mesh
 
 
+def dp_sharding(ndim):
+    """NamedSharding that splits dim 0 over the 'dp' mesh axis (the input
+    placement DataParallel gives incoming batches); None when no mesh is
+    up, the mesh has no dp axis, or the value is 0-d."""
+    m = _mesh.get_mesh()
+    if m is None or "dp" not in m.axis_names or ndim == 0:
+        return None
+    return _mesh.sharding_for(P("dp", *([None] * (ndim - 1))))
+
+
+def dp_device_put(raw):
+    """H2D-place one host batch array with the dp input placement — the
+    shared primitive behind DataParallel._shard_input and the DataLoader's
+    prefetch_to_device stage, so prefetched batches land on device already
+    sharded the way the wrapped forward expects them.  Falls back to an
+    unsharded (uncommitted) device_put when the batch dim doesn't tile the
+    dp axis or no mesh is configured."""
+    sh = dp_sharding(getattr(raw, "ndim", 0))
+    shape = getattr(raw, "shape", ())
+    if sh is None or shape[0] % _mesh.axis_size("dp"):
+        return jax.device_put(raw)
+    if jax.process_count() > 1:
+        # multi-host: this process holds its LOCAL batch; assemble the
+        # global dp-sharded array (batch dim grows to local * processes)
+        import numpy as np
+
+        return jax.make_array_from_process_local_data(sh, np.asarray(raw))
+    return jax.device_put(raw, sh)
+
+
 class _Wrapper(Layer):
     def __init__(self, layers):
         super().__init__()
@@ -64,9 +94,7 @@ class DataParallel(_Wrapper):
     def _shard_input(self, t):
         if not isinstance(t, Tensor) or _mesh.get_mesh() is None:
             return t
-        nd = len(t.shape)
-        spec = P("dp", *([None] * (nd - 1)))
-        sh = _mesh.sharding_for(spec)
+        sh = dp_sharding(len(t.shape))
         raw = t._raw
         if sh is None or isinstance(raw, jax.core.Tracer):
             return t

@@ -65,6 +65,17 @@ def _is_finite(loss):
     return math.isfinite(v)
 
 
+def _deferred_payload(loss):
+    """The device array behind a deferred loss, or None if `loss` is a
+    plain host value (float/None/numpy scalar) that can be checked now."""
+    raw = getattr(loss, "_raw", loss)
+    if type(raw).__module__.split(".")[0] == "jax" or (
+        hasattr(raw, "block_until_ready") and hasattr(raw, "dtype")
+    ):
+        return raw
+    return None
+
+
 class Supervisor:
     """Step-loop guard: non-finite watchdog, SIGTERM → checkpoint + exit 75.
 
@@ -89,6 +100,12 @@ class Supervisor:
         self.step = 0
         self.bad_steps = 0  # consecutive
         self.total_bad_steps = 0
+        # deferred (device-resident) losses awaiting a finiteness check:
+        # (payload, scaler_found_inf_at_step_time) pairs.  The async fit
+        # loop drains this at every log_freq boundary; pending_limit bounds
+        # detection latency (and memory) for loops that never drain.
+        self._pending = []
+        self.pending_limit = 128
         self.preempted = False
         self._signum = None
         self._scaler = None
@@ -135,8 +152,12 @@ class Supervisor:
     def __enter__(self):
         return self
 
-    def __exit__(self, *exc):
-        self.uninstall()
+    def __exit__(self, exc_type, *exc):
+        try:
+            if exc_type is None:
+                self.drain()  # deferred losses must not escape unchecked
+        finally:
+            self.uninstall()
         return False
 
     # -- scaler integration ------------------------------------------------
@@ -158,7 +179,15 @@ class Supervisor:
     def after_step(self, loss=None):
         """Record one finished step.  Raises NonFiniteLossError after
         `max_bad_steps` CONSECUTIVE non-finite steps; calls maybe_exit()
-        so a pending preemption turns into checkpoint + exit."""
+        so a pending preemption turns into checkpoint + exit.
+
+        `loss` may be a host float (checked immediately, the PR-1
+        contract) or a DEVICE-RESIDENT scalar (paddle Tensor / jax array):
+        deferred losses are queued without a host sync and checked when
+        the ring drains — at the caller's next ``drain()`` (the async fit
+        loop drains every log_freq boundary) or automatically once
+        ``pending_limit`` entries accumulate, so divergence detection
+        latency stays bounded either way."""
         _inj.inject("supervisor.step")
         self.step += 1
         if self.heartbeat is not None:
@@ -166,7 +195,20 @@ class Supervisor:
             # diagnostic on a stall names where training stopped advancing
             self.heartbeat.beat(step=self.step)
         _hb.check_peer_abort()  # a dead peer => exit 75, don't enter the next collective
-        bad = not _is_finite(loss) or self._scaler_found_inf()
+        payload = _deferred_payload(loss)
+        if payload is not None:
+            # scaler skip-state is per-step: capture it now, judge it later
+            self._pending.append((payload, self._scaler_found_inf()))
+            if len(self._pending) >= self.pending_limit:
+                self.drain()
+            self.maybe_exit()
+            return True
+        bad = self._account(not _is_finite(loss) or self._scaler_found_inf(), loss)
+        self.maybe_exit()
+        return not bad
+
+    def _account(self, bad, loss_repr):
+        """Consecutive non-finite bookkeeping for one step outcome."""
         if bad:
             self.bad_steps += 1
             self.total_bad_steps += 1
@@ -178,14 +220,39 @@ class Supervisor:
                 raise NonFiniteLossError(
                     f"training diverged: {self.bad_steps} consecutive "
                     f"non-finite steps (step {self.step}, last loss "
-                    f"{loss!r}, {self.total_bad_steps} bad steps total). "
+                    f"{loss_repr!r}, {self.total_bad_steps} bad steps total). "
                     "Lower the learning rate, check the data pipeline, or "
                     "raise max_bad_steps if spikes are expected."
                 )
         else:
             self.bad_steps = 0
-        self.maybe_exit()
-        return not bad
+        return bad
+
+    def drain(self, values=None):
+        """Materialize and account every deferred loss, oldest first.
+
+        One host sync for the whole ring: the payloads are stacked into a
+        single device array and fetched together.  `values` lets a caller
+        that already materialized the same window (the async fit loop does,
+        for its log output) hand the floats over so the window pays exactly
+        one device round-trip in total.  Raises NonFiniteLossError exactly
+        as the immediate path would; entries after the raising one stay
+        dropped (the job is aborting anyway)."""
+        if not self._pending:
+            return True
+        pending, self._pending = self._pending, []
+        if values is None:
+            import jax.numpy as jnp
+            import numpy as np
+
+            values = np.asarray(
+                jnp.stack([jnp.reshape(p, ()).astype(jnp.float32) for p, _ in pending])
+            )
+        ok = True
+        for (_, flagged), v in zip(pending, values):
+            v = float(v)
+            ok &= not self._account(flagged or not math.isfinite(v), v)
+        return ok
 
     # -- preemption / crash checkpoint -------------------------------------
     def _best_effort_save(self, why):

@@ -416,6 +416,12 @@ define_flag(
     "FLAGS_eager_cache_max_entries", 4096,
     "LRU bound on the eager dispatch executable cache (ops/dispatch.py)",
 )
+define_flag(
+    "FLAGS_max_inflight_steps", 2,
+    "bound on device steps the async hapi train loop keeps in flight before "
+    "the host blocks (backpressure without a value transfer); 1 = strict "
+    "per-step sync fallback, identical numerics",
+)
 
 
 # ---------------------------------------------------------------------------

@@ -3,11 +3,20 @@
 
 from __future__ import annotations
 
-import numpy as np
-
 from .. import ops
 from ..io import DataLoader
 from ..tensor import Tensor
+
+
+def _materialize_losses(raws):
+    """ONE host sync for a window of device-resident scalar losses: stack
+    on device, fetch together.  Routed through Tensor.numpy so sync-audit
+    tooling (tests monkeypatch-count blocking materializations) sees it."""
+    import jax.numpy as jnp
+
+    return Tensor(
+        jnp.stack([jnp.reshape(r, ()).astype(jnp.float32) for r in raws])
+    ).numpy()
 
 
 class Callback:
@@ -130,9 +139,16 @@ class Model:
         self._loss = loss
         self._metrics = metrics if isinstance(metrics, (list, tuple)) else ([metrics] if metrics else [])
 
-    def _update_metrics(self, out, label):
-        vals = {}
+    def _metrics_update(self, out, label):
+        """Feed each metric one batch WITHOUT reading accumulators: the
+        device path (update_on_device) keeps running sums as jax arrays —
+        zero host syncs — and the host compute/update path is the fallback
+        for metrics without one.  Accumulator reads (the float() storm the
+        seed paid per step) happen only in _collect_metrics, at
+        log_freq/epoch boundaries that actually consume them."""
         for m in self._metrics:
+            if m.update_on_device(out, label):
+                continue
             r = m.compute(out, label)
             # the base Metric.compute passes (pred, label) through as a
             # tuple for update(pred, label)-style metrics (Precision etc.)
@@ -140,6 +156,12 @@ class Model:
                 m.update(*r)
             else:
                 m.update(r)
+
+    def _collect_metrics(self):
+        """Reduce every metric to Python floats (the only sync point of the
+        metrics pipeline)."""
+        vals = {}
+        for m in self._metrics:
             acc = m.accumulate()
             names = m.name()
             if isinstance(acc, (tuple, list)):
@@ -151,7 +173,16 @@ class Model:
                 vals[names if not isinstance(names, (tuple, list)) else names[0]] = float(acc)
         return vals
 
+    def _update_metrics(self, out, label):
+        # compat shim for the seed's update+read-per-step shape
+        self._metrics_update(out, label)
+        return self._collect_metrics()
+
     def train_batch(self, inputs, labels=None):
+        """One optimizer step.  The returned loss is DEVICE-RESIDENT — the
+        host dispatches the step and moves on; materialize with
+        float()/.numpy() only where the value is consumed (fit does so at
+        log_freq boundaries).  Metrics accumulate on device too."""
         self.network.train()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         out = self.network(*inputs)
@@ -160,17 +191,19 @@ class Model:
         loss.backward()
         self._optimizer.step()
         self._optimizer.clear_grad()
-        self._last_metrics = self._update_metrics(out, label)
-        return [float(loss.numpy())]
+        self._metrics_update(out, label)
+        return [loss]
 
     def eval_batch(self, inputs, labels=None):
+        """Forward + loss; device-resident return, same contract as
+        train_batch."""
         self.network.eval()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         out = self.network(*inputs)
         label = labels if not isinstance(labels, (list, tuple)) else labels[0]
         loss = self._loss(out, label)
-        self._last_metrics = self._update_metrics(out, label)
-        return [float(loss.numpy())]
+        self._metrics_update(out, label)
+        return [loss]
 
     def predict_batch(self, inputs):
         self.network.eval()
@@ -184,9 +217,25 @@ class Model:
         compute on a diverged job, and SIGTERM/preemption checkpoints
         best-effort (to `save_dir/preempt` when save_dir is set) and exits
         with the restart-requested code the launch controller honors.
-        Pass max_bad_steps=0 to disable the watchdog."""
+        Pass max_bad_steps=0 to disable the watchdog.
+
+        ASYNC STEP PIPELINE: the loop never blocks on a step's loss value.
+        Device-resident losses accumulate in a window; the host materializes
+        them (ONE stacked fetch) only at log_freq boundaries and epoch ends —
+        the points whose callbacks actually consume floats.  The supervisor's
+        NaN watchdog drains the same window at the same boundaries, so
+        divergence detection latency is bounded by log_freq without a
+        per-step sync.  FLAGS_max_inflight_steps bounds how far the host
+        runs ahead of the device (backpressure via block_until_ready — a
+        completion wait, not a value transfer); set it to 1 for the strict
+        per-step sync loop (identical numerics, the seed behavior)."""
+        import collections
+        import time
+
         from ..fault import Supervisor
         from ..fault import watchdog as _wd
+        from ..framework import core as _core
+        from .. import profiler as _prof
 
         loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
             train_data, batch_size=batch_size, shuffle=shuffle, drop_last=drop_last, num_workers=num_workers
@@ -206,6 +255,8 @@ class Model:
                 os.makedirs(save_dir, exist_ok=True)
                 self.save(os.path.join(save_dir, "preempt"))
 
+        inflight = max(1, int(_core.flag("FLAGS_max_inflight_steps")))
+        sync_mode = inflight <= 1
         cblist.call("on_train_begin")
         history = []
         with Supervisor(save_fn=save_fn, max_bad_steps=max_bad_steps) as sup:
@@ -213,17 +264,69 @@ class Model:
                 cblist.call("on_epoch_begin", epoch)
                 for m in self._metrics:
                     m.reset()
-                losses = []
+                epoch_sum, epoch_n = 0.0, 0
+                window = []  # device losses since the last sync point
+                ring = collections.deque()  # bounded in-flight steps
+
+                def _materialize():
+                    """One host sync for the whole window: the stacked
+                    losses come back together, and the supervisor ring
+                    drains with the SAME values (no second round-trip)."""
+                    nonlocal epoch_sum, epoch_n, window
+                    vals = _materialize_losses(window)
+                    window = []
+                    ring.clear()  # everything up to here has retired
+                    sup.drain(values=vals)
+                    for v in vals:  # per-value float64 adds: the epoch mean
+                        epoch_sum += float(v)  # is window-size invariant
+                    epoch_n += len(vals)
+                    return vals
+
+                last_end = time.perf_counter()
                 for step, batch in enumerate(loader):
                     cblist.call("on_train_batch_begin", step)
                     x, y = batch[0], batch[1]
+                    t0 = time.perf_counter()
                     with sup.guard(), _wd.arm("fit.train_batch", context=f"step {step}"):
-                        loss = self.train_batch(x, y)[0]
-                    losses.append(loss)
-                    logs = {"loss": loss, **getattr(self, "_last_metrics", {})}
+                        loss_t = self.train_batch(x, y)[0]
+                    t1 = time.perf_counter()
+                    window.append(getattr(loss_t, "_raw", loss_t))
+                    sup.after_step(loss_t)  # deferred: heartbeat + preemption
+                    # poll now, finiteness at the next drain
+                    host_block = 0.0
+                    if not sync_mode:
+                        ring.append(window[-1])
+                        if len(ring) > inflight:
+                            tb = time.perf_counter()
+                            old = ring.popleft()
+                            if hasattr(old, "block_until_ready"):
+                                old.block_until_ready()
+                            host_block += time.perf_counter() - tb
+                    if sync_mode or step % log_freq == 0:
+                        tb = time.perf_counter()
+                        vals = _materialize()  # may raise NonFiniteLossError
+                        host_block += time.perf_counter() - tb
+                        logs = {"loss": float(vals[-1]), **self._collect_metrics()}
+                    else:
+                        # between boundaries callbacks get the live device
+                        # tensor — consuming it (float()) is the caller
+                        # opting into a sync
+                        logs = {"loss": loss_t}
                     cblist.call("on_train_batch_end", step, logs)
-                    sup.after_step(loss)
-                epoch_logs = {"loss": float(np.mean(losses)), **getattr(self, "_last_metrics", {})}
+                    now = time.perf_counter()
+                    _prof.record_step(
+                        dispatch_s=t1 - t0,
+                        host_blocked_s=host_block,
+                        inflight=len(ring),
+                        wall_s=now - last_end,
+                    )
+                    last_end = now
+                if window:
+                    _materialize()  # epoch-end sync: mean loss + NaN drain
+                epoch_logs = {
+                    "loss": epoch_sum / max(epoch_n, 1),
+                    **self._collect_metrics(),
+                }
                 history.append(epoch_logs["loss"])
                 cblist.call("on_epoch_end", epoch, epoch_logs)
                 if eval_data is not None and (epoch + 1) % eval_freq == 0:
@@ -236,16 +339,31 @@ class Model:
         return history
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2, num_workers=0, callbacks=None):
+        """Evaluation loop — fully async: per-batch losses stay on device
+        and are materialized once at eval end (metrics likewise)."""
+        import collections
+
+        from ..framework import core as _core
+
         loader = eval_data if isinstance(eval_data, DataLoader) else DataLoader(eval_data, batch_size=batch_size)
         cblist = _CallbackList(callbacks, self)
         cblist.call("on_eval_begin")
         for m in self._metrics:
             m.reset()
-        losses = []
+        inflight = max(1, int(_core.flag("FLAGS_max_inflight_steps")))
+        raws = []
+        ring = collections.deque()
         for batch in loader:
             x, y = batch[0], batch[1]
-            losses.append(self.eval_batch(x, y)[0])
-        result = {"loss": float(np.mean(losses)), **getattr(self, "_last_metrics", {})}
+            loss_t = self.eval_batch(x, y)[0]
+            raws.append(getattr(loss_t, "_raw", loss_t))
+            ring.append(raws[-1])
+            if len(ring) > inflight:
+                old = ring.popleft()
+                if hasattr(old, "block_until_ready"):
+                    old.block_until_ready()
+        mean = float(_materialize_losses(raws).mean()) if raws else float("nan")
+        result = {"loss": mean, **self._collect_metrics()}
         cblist.call("on_eval_end", result)
         if verbose:
             print(f"eval: {result}")
@@ -270,9 +388,32 @@ class Model:
             save(self._optimizer.state_dict(), path + ".pdopt")
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        """Load weights from `path + ".pdparams"`, and — when an optimizer
+        is prepared — its accumulators/master weights from `path + ".pdopt"`
+        if that file exists.  `reset_optimizer=True` instead discards all
+        optimizer statistics (fresh moments, step count 0), the reference
+        paddle.Model.load contract.  `skip_mismatch` maps to the
+        optimizer's non-strict restore (unmatched entries warn, not
+        raise)."""
+        import os
+
         from ..framework.io import load
 
         self.network.set_state_dict(load(path + ".pdparams"))
+        opt = self._optimizer
+        if opt is None:
+            return
+        if reset_optimizer:
+            for attr in ("_accumulators", "_master_weights"):
+                d = getattr(opt, attr, None)
+                if isinstance(d, dict):
+                    d.clear()
+            if hasattr(opt, "_step_count"):
+                opt._step_count = 0
+            return
+        opt_path = path + ".pdopt"
+        if os.path.exists(opt_path):
+            opt.set_state_dict(load(opt_path), strict=not skip_mismatch)
 
     def summary(self, input_size=None, dtype=None):
         total = sum(p.size for p in self.network.parameters())

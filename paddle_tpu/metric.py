@@ -24,6 +24,15 @@ class Metric:
     def compute(self, *args):
         return args
 
+    def update_on_device(self, pred, label):
+        """Accumulate one batch WITHOUT a host sync: running sums/counts
+        stay device-resident (jax scalars) and are reduced to Python floats
+        only when ``accumulate()`` is read.  Returns True when this metric
+        handled the batch on device; False sends the caller down the
+        classic ``compute``/``update`` host path.  The base class has no
+        device path."""
+        return False
+
 
 class Accuracy(Metric):
     def __init__(self, topk=(1,), name=None):
@@ -35,6 +44,52 @@ class Accuracy(Metric):
     def reset(self):
         self.total = [0.0] * len(self.topk)
         self.count = [0] * len(self.topk)
+        self._dev_total = None  # per-k jax scalars (update_on_device path)
+        self._dev_count = [0] * len(self.topk)
+
+    def update_on_device(self, pred, label):
+        """Device-side top-k accuracy: the correctness sums stay jax
+        scalars (the per-batch count is static, derived from shapes), so a
+        training loop that only READS accuracy at log boundaries never
+        syncs per step.  Mirrors compute()+update() numerics exactly
+        (same argsort tie-breaking)."""
+        import jax
+        import jax.numpy as jnp
+
+        p = pred._raw if isinstance(pred, Tensor) else pred
+        l = label._raw if isinstance(label, Tensor) else label
+        if isinstance(p, jax.core.Tracer) or isinstance(l, jax.core.Tracer):
+            return False  # inside a trace host-side sums can't accumulate
+        try:
+            p = jnp.asarray(p)
+            l = jnp.asarray(l)
+        except TypeError:
+            return False
+        if l.ndim == p.ndim and l.shape[-1] == 1:
+            l = l[..., 0]
+        top = jnp.argsort(-p, axis=-1)[..., : self.maxk]
+        correct = (top == l[..., None]).astype(jnp.float32)
+        n = int(np.prod(correct.shape[:-1]))
+        if self._dev_total is None:
+            self._dev_total = [jnp.zeros((), jnp.float32) for _ in self.topk]
+        for i, k in enumerate(self.topk):
+            self._dev_total[i] = self._dev_total[i] + correct[..., :k].sum()
+            self._dev_count[i] += n
+        return True
+
+    def _fold_device(self):
+        """Reduce the device-resident sums into the host totals — ONE
+        stacked host fetch for all k, paid only when accumulate() is read."""
+        if self._dev_total is None:
+            return
+        import jax.numpy as jnp
+
+        vals = np.asarray(jnp.stack(self._dev_total))
+        for i, v in enumerate(vals):
+            self.total[i] += float(v)
+            self.count[i] += self._dev_count[i]
+        self._dev_total = None
+        self._dev_count = [0] * len(self.topk)
 
     def compute(self, pred, label, *args):
         pred_np = pred.numpy() if isinstance(pred, Tensor) else np.asarray(pred)
@@ -57,6 +112,7 @@ class Accuracy(Metric):
         return accs[0] if len(accs) == 1 else accs
 
     def accumulate(self):
+        self._fold_device()
         res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
         return res[0] if len(res) == 1 else res
 

@@ -58,6 +58,60 @@ def export_protobuf(dir_name, worker_name=None):
     return export_chrome_tracing(dir_name, worker_name)
 
 
+# ---------------------------------------------------------------------------
+# Async step-pipeline gauges (ISSUE 4): the hapi fit loop reports, per step,
+# how long the host spent dispatching work vs blocked on the device
+# (backpressure + log-boundary materialization) and how many steps were in
+# flight.  wall - dispatch - host_blocked estimates pure device-bound time
+# the host successfully hid.
+# ---------------------------------------------------------------------------
+
+_step_gauges = {
+    "steps": 0,
+    "dispatch_s": 0.0,
+    "host_blocked_s": 0.0,
+    "wall_s": 0.0,
+    "inflight_sum": 0,
+    "inflight_max": 0,
+}
+
+
+def record_step(dispatch_s=0.0, host_blocked_s=0.0, inflight=0, wall_s=0.0):
+    """One training step's host-time split + in-flight ring depth."""
+    g = _step_gauges
+    g["steps"] += 1
+    g["dispatch_s"] += dispatch_s
+    g["host_blocked_s"] += host_blocked_s
+    g["wall_s"] += wall_s
+    g["inflight_sum"] += inflight
+    if inflight > g["inflight_max"]:
+        g["inflight_max"] = inflight
+
+
+def reset_step_breakdown():
+    for k in _step_gauges:
+        _step_gauges[k] = 0 if isinstance(_step_gauges[k], int) else 0.0
+
+
+def step_breakdown():
+    """Aggregated step-time split: host-blocked vs dispatch vs device
+    estimate, plus the in-flight-depth gauge (avg/max)."""
+    g = _step_gauges
+    n = g["steps"]
+    out = {"steps": n}
+    if not n:
+        return out
+    out["dispatch_ms_avg"] = g["dispatch_s"] / n * 1e3
+    out["host_blocked_ms_avg"] = g["host_blocked_s"] / n * 1e3
+    out["wall_ms_avg"] = g["wall_s"] / n * 1e3
+    out["device_ms_avg_est"] = max(
+        0.0, (g["wall_s"] - g["dispatch_s"] - g["host_blocked_s"]) / n * 1e3
+    )
+    out["inflight_depth_avg"] = g["inflight_sum"] / n
+    out["inflight_depth_max"] = g["inflight_max"]
+    return out
+
+
 class RecordEvent:
     """Host-span annotation; shows up in the XPlane host timeline
     (reference: platform::RecordEvent)."""
@@ -168,6 +222,14 @@ class Profiler:
         if self._step_times:
             avg = sum(self._step_times) / len(self._step_times)
             print(f"steps: {len(self._step_times)}  avg step time: {avg*1000:.3f} ms")
+        bd = step_breakdown()
+        if bd["steps"]:
+            print(
+                "async pipeline: {steps} steps  dispatch {dispatch_ms_avg:.3f} ms"
+                "  host-blocked {host_blocked_ms_avg:.3f} ms"
+                "  device(est) {device_ms_avg_est:.3f} ms"
+                "  inflight avg {inflight_depth_avg:.2f} max {inflight_depth_max}".format(**bd)
+            )
         # compile caches dominate cold-start cost: surface them next to the
         # step timing so "why was the first step slow" is answerable here
         try:

@@ -1,0 +1,294 @@
+"""Async step pipeline (ISSUE 4): no-sync guarantee in fit(), device-side
+metric accumulators, deferred supervisor losses, sync/async numerical
+parity, and Model.load optimizer restore."""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.tensor import Tensor
+
+
+@pytest.fixture(autouse=True)
+def _restore_inflight_flag():
+    from paddle_tpu.framework import core as _core
+
+    prev = _core.flag("FLAGS_max_inflight_steps")
+    yield
+    paddle.set_flags({"FLAGS_max_inflight_steps": prev})
+
+
+class _Data:
+    def __init__(self, n=64, d=8, c=4):
+        r = np.random.RandomState(0)
+        self.x = r.rand(n, d).astype(np.float32)
+        self.y = r.randint(0, c, (n,)).astype(np.int64)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _model(lr=1e-2, metrics=True):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    model = paddle.Model(net)
+    model.prepare(
+        paddle.optimizer.Adam(learning_rate=lr, parameters=net.parameters()),
+        nn.CrossEntropyLoss(),
+        paddle.metric.Accuracy() if metrics else None,
+    )
+    return model
+
+
+def _count_syncs(monkeypatch):
+    """Monkeypatch-count BLOCKING host materializations."""
+    counts = {"n": 0}
+    orig_numpy = Tensor.numpy
+    orig_float = Tensor.__float__
+
+    def numpy(self):
+        counts["n"] += 1
+        return orig_numpy(self)
+
+    def fl(self):
+        counts["n"] += 1
+        return orig_float(self)
+
+    monkeypatch.setattr(Tensor, "numpy", numpy)
+    monkeypatch.setattr(Tensor, "__float__", fl)
+    return counts
+
+
+# ------------------------------------------------------------- no-sync proof
+
+
+def test_fit_no_sync_guarantee(monkeypatch):
+    """Steady-state fit() materializes at most once per log_freq window plus
+    once per epoch end — the per-step float(loss.numpy())/metric float()
+    storm is gone."""
+    paddle.set_flags({"FLAGS_max_inflight_steps": 2})
+    model = _model()
+    data = _Data(64)  # batch 8 -> 8 steps/epoch
+    epochs, steps, log_freq = 2, 8, 4
+    counts = _count_syncs(monkeypatch)
+    model.fit(data, batch_size=8, epochs=epochs, log_freq=log_freq, verbose=0, shuffle=False)
+    budget = (math.ceil(steps / log_freq) + 1) * epochs  # boundaries + epoch end
+    assert counts["n"] <= budget, f"{counts['n']} syncs > budget {budget}"
+    assert counts["n"] >= epochs  # the boundaries really materialize
+
+
+def test_sync_fallback_materializes_per_step(monkeypatch):
+    """FLAGS_max_inflight_steps=1 is the strict per-step loop (one
+    materialization per step, seed semantics)."""
+    paddle.set_flags({"FLAGS_max_inflight_steps": 1})
+    model = _model(metrics=False)
+    counts = _count_syncs(monkeypatch)
+    model.fit(_Data(32), batch_size=8, epochs=1, verbose=0, shuffle=False)
+    assert counts["n"] >= 4  # 4 steps, each a boundary
+
+
+# ------------------------------------------------------------------- parity
+
+
+def test_sync_async_numerical_parity():
+    """Both loop modes run the identical compute graph — same history,
+    same final weights, bit-for-bit."""
+    data = _Data(32)
+
+    def run(flag):
+        paddle.set_flags({"FLAGS_max_inflight_steps": flag})
+        model = _model()
+        hist = model.fit(data, batch_size=4, epochs=2, verbose=0, shuffle=False)
+        return hist, [p.numpy().copy() for p in model.parameters()]
+
+    h_sync, w_sync = run(1)
+    h_async, w_async = run(3)
+    np.testing.assert_allclose(h_sync, h_async, rtol=0, atol=0)
+    for a, b in zip(w_sync, w_async):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_train_batch_returns_device_resident_loss():
+    model = _model(metrics=False)
+    x = paddle.to_tensor(np.random.RandomState(0).rand(4, 8).astype(np.float32))
+    y = paddle.to_tensor(np.arange(4).astype(np.int64) % 4)
+    loss = model.train_batch(x, y)[0]
+    assert isinstance(loss, Tensor)  # not a pre-synced float
+    assert np.isfinite(float(loss))  # materializing is the caller's call
+
+
+# ---------------------------------------------------------- deferred watchdog
+
+
+def test_supervisor_accepts_deferred_loss():
+    from paddle_tpu import fault
+
+    sup = fault.Supervisor(max_bad_steps=3, handle_signals=False)
+    good = paddle.to_tensor(np.float32(1.0))
+    bad = paddle.to_tensor(np.float32("nan"))
+    for _ in range(2):
+        sup.after_step(good)
+    for _ in range(3):
+        sup.after_step(bad)
+    with pytest.raises(fault.NonFiniteLossError):
+        sup.drain()
+
+
+def test_supervisor_pending_ring_bounds_detection_latency():
+    """A loop that never drains still detects divergence: the pending ring
+    auto-drains at pending_limit."""
+    from paddle_tpu import fault
+
+    sup = fault.Supervisor(max_bad_steps=3, handle_signals=False)
+    sup.pending_limit = 4
+    bad = paddle.to_tensor(np.float32("inf"))
+    with pytest.raises(fault.NonFiniteLossError):
+        for _ in range(8):
+            sup.after_step(bad)
+    assert sup.step <= 4  # caught at the ring bound, not at step 8
+
+
+def test_supervisor_context_exit_drains():
+    from paddle_tpu import fault
+
+    bad = paddle.to_tensor(np.float32("nan"))
+    with pytest.raises(fault.NonFiniteLossError):
+        with fault.Supervisor(max_bad_steps=2, handle_signals=False) as sup:
+            sup.after_step(bad)
+            sup.after_step(bad)
+            # no explicit drain: __exit__ must not let them escape unchecked
+
+
+def test_fit_async_detects_divergence():
+    """End to end: lr=1e30 diverges; the async loop's boundary drain raises
+    within the epoch, no per-step sync needed."""
+    paddle.set_flags({"FLAGS_max_inflight_steps": 4})
+    from paddle_tpu import fault
+
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    model = paddle.Model(net)
+    model.prepare(
+        paddle.optimizer.SGD(learning_rate=1e30, parameters=net.parameters()),
+        nn.MSELoss(),
+    )
+    data = [
+        (np.random.RandomState(i).rand(4).astype(np.float32) * 1e6, np.zeros((2,), np.float32))
+        for i in range(32)
+    ]
+    with pytest.raises(fault.NonFiniteLossError, match="diverged"):
+        model.fit(data, batch_size=4, epochs=4, verbose=0, max_bad_steps=3)
+
+
+# ------------------------------------------------------------ device metrics
+
+
+def test_accuracy_device_path_matches_host():
+    r = np.random.RandomState(0)
+    pred = r.rand(32, 5).astype(np.float32)
+    label = r.randint(0, 5, (32, 1)).astype(np.int64)
+
+    host = paddle.metric.Accuracy(topk=(1, 2))
+    host.update(host.compute(paddle.to_tensor(pred), paddle.to_tensor(label)))
+
+    dev = paddle.metric.Accuracy(topk=(1, 2))
+    assert dev.update_on_device(paddle.to_tensor(pred), paddle.to_tensor(label))
+    np.testing.assert_allclose(host.accumulate(), dev.accumulate(), rtol=1e-6)
+
+
+def test_accuracy_device_path_no_tensor_sync(monkeypatch):
+    counts = _count_syncs(monkeypatch)
+    m = paddle.metric.Accuracy()
+    r = np.random.RandomState(0)
+    for _ in range(4):
+        m.update_on_device(
+            paddle.to_tensor(r.rand(8, 3).astype(np.float32)),
+            paddle.to_tensor(r.randint(0, 3, (8,)).astype(np.int64)),
+        )
+    assert counts["n"] == 0  # updates never touch the host
+    acc = m.accumulate()  # the read is the only reduction point
+    assert 0.0 <= acc <= 1.0
+
+
+def test_accuracy_mixed_device_and_host_updates():
+    r = np.random.RandomState(1)
+    pred1, lab1 = r.rand(8, 4).astype(np.float32), r.randint(0, 4, (8,)).astype(np.int64)
+    pred2, lab2 = r.rand(8, 4).astype(np.float32), r.randint(0, 4, (8,)).astype(np.int64)
+
+    mixed = paddle.metric.Accuracy()
+    mixed.update_on_device(paddle.to_tensor(pred1), paddle.to_tensor(lab1))
+    mixed.update(mixed.compute(paddle.to_tensor(pred2), paddle.to_tensor(lab2)))
+
+    host = paddle.metric.Accuracy()
+    for p, l in ((pred1, lab1), (pred2, lab2)):
+        host.update(host.compute(paddle.to_tensor(p), paddle.to_tensor(l)))
+    np.testing.assert_allclose(host.accumulate(), mixed.accumulate(), rtol=1e-6)
+
+
+# ------------------------------------------------------- profiler breakdown
+
+
+def test_profiler_step_breakdown_gauge():
+    from paddle_tpu import profiler
+
+    profiler.reset_step_breakdown()
+    model = _model(metrics=False)
+    model.fit(_Data(32), batch_size=8, epochs=1, verbose=0, shuffle=False)
+    bd = profiler.step_breakdown()
+    assert bd["steps"] == 4
+    assert bd["dispatch_ms_avg"] > 0
+    assert bd["inflight_depth_max"] <= 2  # bounded by FLAGS_max_inflight_steps
+    profiler.reset_step_breakdown()
+    assert profiler.step_breakdown()["steps"] == 0
+
+
+# ------------------------------------------------------- Model.load satellite
+
+
+def test_model_load_restores_optimizer_state(tmp_path):
+    model = _model()
+    model.fit(_Data(16), batch_size=4, epochs=1, verbose=0)
+    path = str(tmp_path / "ck")
+    model.save(path)
+    assert os.path.exists(path + ".pdopt")
+    snap = {
+        k: v.numpy().copy()
+        for k, v in model._optimizer.state_dict().items()
+        if isinstance(v, Tensor)
+    }
+    step_at_save = model._optimizer._step_count
+
+    model.fit(_Data(16), batch_size=4, epochs=2, verbose=0)  # diverge past it
+    assert model._optimizer._step_count != step_at_save
+
+    model.load(path)  # rolls BOTH weights and optimizer moments back
+    assert model._optimizer._step_count == step_at_save
+    moment_keys = [k for k in snap if k.endswith("_moment1")]
+    assert moment_keys
+    cur = model._optimizer.state_dict()
+    for k in moment_keys:
+        np.testing.assert_allclose(cur[k].numpy(), snap[k], rtol=1e-6)
+
+
+def test_model_load_reset_optimizer(tmp_path):
+    model = _model()
+    model.fit(_Data(16), batch_size=4, epochs=1, verbose=0)
+    path = str(tmp_path / "ck")
+    model.save(path)
+
+    m3 = _model()
+    m3.fit(_Data(16), batch_size=4, epochs=1, verbose=0)  # dirty state to discard
+    m3.load(path, reset_optimizer=True)
+    assert m3._optimizer._step_count == 0
+    assert not m3._optimizer._accumulators
+    np.testing.assert_allclose(
+        m3.network.state_dict()["0.weight"].numpy(),
+        model.network.state_dict()["0.weight"].numpy(),
+    )

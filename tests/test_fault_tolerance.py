@@ -538,7 +538,8 @@ class TestLaunchRestarts:
             "    with sup.guard():\n"
             "        loss = (net(x) ** 2).mean()\n"
             "        loss.backward(); opt.step(); opt.clear_grad()\n"
-            "    sup.after_step(float(loss.numpy()))\n"
+            "    sup.after_step(loss)  # deferred: no per-step host sync\n"
+            "    sup.drain()  # checkpointing next -> settle the NaN check\n"
             "    sd = {'net': net.state_dict(), 'opt': opt.state_dict()}\n"
             "    ckpt.save_checkpoint(sd, root, step + 1, keep_last_n=3)\n"
             "    if step == 2 and life == 0:\n"

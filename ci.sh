@@ -64,6 +64,16 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     FLAGS_max_inflight_steps=1 \
     python -m pytest "${SYNC_TESTS[@]}" -q -m "not slow" -p no:cacheprovider
 
+echo "== serving smoke (continuous-batching engine) =="
+# the ISSUE 5 acceptance pair in every tier: steady-state decode stays ONE
+# executable with zero recompiles under mixed-length traffic, and the HTTP
+# front door completes overlapping requests token-exactly (503 on overload)
+SERVE_TESTS=(tests/test_serving_engine.py::test_zero_recompiles_after_warmup
+             tests/test_serving_engine.py::test_mixed_length_compile_count)
+[ "$MODE" != "fast" ] && SERVE_TESTS=(tests/test_serving_engine.py)
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python -m pytest "${SERVE_TESTS[@]}" -q -p no:cacheprovider
+
 if [ "$MODE" != "fast" ]; then
   echo "== bench smoke (CPU) =="
   env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py --all

@@ -422,6 +422,21 @@ define_flag(
     "the host blocks (backpressure without a value transfer); 1 = strict "
     "per-step sync fallback, identical numerics",
 )
+define_flag(
+    "FLAGS_serve_slots", 4,
+    "continuous-batching engine: number of KV-cache slots in the pooled "
+    "StaticKVCache (max concurrently decoding requests)",
+)
+define_flag(
+    "FLAGS_serve_queue_depth", 32,
+    "continuous-batching engine: admission queue bound; submissions beyond "
+    "it fail fast (serve() maps this to HTTP 503)",
+)
+define_flag(
+    "FLAGS_serve_prefill_buckets", "16,32,64,128",
+    "continuous-batching engine: comma-separated prompt-length buckets; each "
+    "bucket compiles one prefill executable (prompts pad up to the bucket)",
+)
 
 
 # ---------------------------------------------------------------------------

@@ -16,10 +16,10 @@ import pickle
 import numpy as np
 import jax
 
-from . import no_grad
-from .framework.io import load as _load
-from .framework.io import save as _save
-from .tensor import Tensor
+from .. import no_grad
+from ..framework.io import load as _load
+from ..framework.io import save as _save
+from ..tensor import Tensor
 
 
 def export(layer, path, example_inputs, with_weights=True, params_from=None):
@@ -135,13 +135,15 @@ class GenerationPredictor:
         self.model = model
         self.max_new_tokens = max_new_tokens
 
-    def generate(self, input_ids, max_new_tokens=None, temperature=0.0):
+    def generate(self, input_ids, max_new_tokens=None, temperature=0.0,
+                 eos_token_id=None):
         ids = np.asarray(input_ids, np.int32)
         if ids.ndim == 1:
             ids = ids[None]
         n = self.max_new_tokens if max_new_tokens is None else int(max_new_tokens)
         out = self.model.generate(
-            Tensor(ids), max_new_tokens=n, temperature=float(temperature)
+            Tensor(ids), max_new_tokens=n, temperature=float(temperature),
+            eos_token_id=eos_token_id,
         )
         return np.asarray(out.numpy())
 
@@ -157,23 +159,45 @@ class GenerationPredictor:
 
 
 def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True):
-    """Minimal serving loop over a compiled program (reference capability:
-    the AnalysisPredictor behind paddle_serving — SURVEY.md §2.1 "Inference
-    runtime").  POST /predict with a JSON body
-    {"inputs": [nested lists, ...]} returns {"outputs": [...]}; GET /health
-    returns 200.  Stdlib-only; one XLA executable, requests run serially
-    (XLA itself parallelizes across the chip).
+    """Serving loop (reference capability: the AnalysisPredictor behind
+    paddle_serving — SURVEY.md §2.1 "Inference runtime").  Stdlib-only
+    ThreadingHTTPServer with a bounded admission gate: requests beyond the
+    queue bound (FLAGS_serve_queue_depth) get 503 + JSON instead of piling
+    up behind the executable.
+
+    - GET  /health            -> 200
+    - POST /predict           -> {"outputs": [...]}   (Predictor)
+    - POST /generate          -> {"tokens": [...]}    (GenerationPredictor or
+      ContinuousBatchingEngine; body: {"input_ids": [...] or [[...], ...],
+      "max_new_tokens": n, "temperature": t, "eos_token_id": id})
+
+    A ContinuousBatchingEngine serves /generate with true continuous
+    batching: concurrent requests decode interleaved in the slot pool, each
+    finishing on its own EOS/length (the lock-based predictors serialize).
     """
     import json
     import threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+    from .engine import ContinuousBatchingEngine, QueueFull
+    from ..framework import core as _fcore
+
     predictor = (
         path_or_predictor
-        if isinstance(path_or_predictor, (Predictor, GenerationPredictor))
+        if isinstance(
+            path_or_predictor,
+            (Predictor, GenerationPredictor, ContinuousBatchingEngine),
+        )
         else Predictor(path_or_predictor)
     )
+    engine = predictor if isinstance(predictor, ContinuousBatchingEngine) else None
+    if engine is not None:
+        engine.start()
     lock = threading.Lock()
+    # admission bound for the lock-based predictor paths: at most
+    # queue_depth requests running-or-waiting; the rest shed with 503
+    # (the engine has its own bounded queue — submit raises QueueFull)
+    gate = threading.BoundedSemaphore(int(_fcore.flag("FLAGS_serve_queue_depth")))
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -187,14 +211,53 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True):
             self.end_headers()
             self.wfile.write(body)
 
+        def _busy(self):
+            self._reply(503, {"error": "admission queue full, retry later"})
+
         def do_GET(self):
             if self.path == "/health":
                 self._reply(200, {"status": "ok"})
             else:
                 self._reply(404, {"error": "use POST /predict"})
 
+        def _generate_engine(self):
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n))
+                ids = req["input_ids"]
+                rows = ids if ids and isinstance(ids[0], list) else [ids]
+                handles = []
+                try:
+                    for row in rows:
+                        handles.append(
+                            engine.submit(
+                                row,
+                                max_new_tokens=int(req.get("max_new_tokens") or 32),
+                                temperature=float(req.get("temperature", 0.0)),
+                                eos_token_id=req.get("eos_token_id"),
+                            )
+                        )
+                except QueueFull:
+                    # rows already admitted still complete server-side;
+                    # the client sheds and retries the whole batch
+                    self._busy()
+                    return
+                outs = [h.wait(timeout=600).tolist() for h in handles]
+                self._reply(
+                    200,
+                    {"tokens": outs if isinstance(ids[0], list) else outs[0]},
+                )
+            except Exception as e:
+                self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+
         def do_POST(self):
+            if self.path == "/generate" and engine is not None:
+                self._generate_engine()
+                return
             if self.path == "/generate" and isinstance(predictor, GenerationPredictor):
+                if not gate.acquire(blocking=False):
+                    self._busy()
+                    return
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n))
@@ -203,13 +266,19 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True):
                             req["input_ids"],
                             max_new_tokens=req.get("max_new_tokens"),
                             temperature=req.get("temperature", 0.0),
+                            eos_token_id=req.get("eos_token_id"),
                         )
                     self._reply(200, {"tokens": toks.tolist()})
                 except Exception as e:
                     self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                finally:
+                    gate.release()
                 return
-            if self.path != "/predict" or isinstance(predictor, GenerationPredictor):
+            if self.path != "/predict" or not isinstance(predictor, Predictor):
                 self._reply(404, {"error": "use POST /predict or /generate"})
+                return
+            if not gate.acquire(blocking=False):
+                self._busy()
                 return
             try:
                 n = int(self.headers.get("Content-Length", 0))
@@ -225,6 +294,8 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True):
                 self._reply(200, {"outputs": [o.tolist() for o in outs]})
             except Exception as e:
                 self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+            finally:
+                gate.release()
 
     server = ThreadingHTTPServer((host, port), Handler)
     if block:
@@ -233,3 +304,13 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True):
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()
     return server
+
+
+def __getattr__(name):
+    # engine symbols load lazily: paddle_tpu/__init__ imports this module
+    # during package init, before the model stack the engine depends on
+    if name in ("ContinuousBatchingEngine", "EngineRequest", "QueueFull"):
+        from . import engine as _engine
+
+        return getattr(_engine, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,497 @@
+"""Continuous-batching inference engine (reference capability: the inference
+runtime's flash-decode serving path, SURVEY §2.1 L8 — scheduling layer).
+
+The lock-step `GenerationPredictor` runs every request in a batch from first
+token to last together: one long generation holds the whole batch hostage,
+and a new request waits for the batch to drain.  This engine instead owns a
+persistent SLOT POOL of `StaticKVCache` buffers (`[slots, max_len, kv_heads,
+head_dim]` per layer) and runs ONE compiled decode step whatever the
+occupancy: per-slot `pos` and `active` masks are DATA, never shapes, so
+requests joining, finishing, and slots being recycled cause zero recompiles
+after warmup.
+
+New requests are prefilled through length-bucketed compiled prefill
+executables — the prompt pads up to its bucket, attends to itself causally,
+and its K/V land in the assigned pool slot (slot index is data too, so one
+executable per bucket serves every slot).  Prefills interleave with in-flight
+decode at step granularity; finished slots (EOS or max_new_tokens) are
+recycled immediately.
+
+Why padding garbage is safe: a prefill writes rows [0, bucket) of its slot,
+rows [true_len, bucket) holding padding K/V.  Decode at position p first
+overwrites row p, then attends rows j <= p only — every garbage row is
+overwritten by the decode step that first brings it into the attended window.
+Inactive slots decode with pos forced to 0; their row-0 write is scratch
+because the next prefill into that slot always rewrites row 0.
+
+Compiled-executable budget: len(prefill_buckets) + 1 (asserted by tests via
+`compile_counts()`).  Both functions ride @to_static, so PR 3's persistent
+compile cache and AOT snapshots apply per bucket: a restarted server binds
+the previous process's executables without tracing.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+from ..framework import core as _fcore
+from ..models.llama import SlotView, StaticKVCache
+from ..tensor import Tensor
+
+
+class QueueFull(RuntimeError):
+    """Admission queue at capacity — submit() fails fast (serve() maps this
+    to HTTP 503)."""
+
+
+class EngineRequest:
+    """Handle for one submitted generation: streaming callback target,
+    completion event, and timing for the serving gauges."""
+
+    def __init__(self, prompt, max_new_tokens, temperature, eos_token_id, on_token):
+        self.prompt = prompt  # np.int32 [L]
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.eos_token_id = eos_token_id
+        self.on_token = on_token
+        self.tokens = []  # generated ids (includes eos when hit)
+        self.finished = threading.Event()
+        self.finish_reason = None  # "eos" | "length" | "error"
+        self.error = None
+        self.ttft_s = None
+        self._submit_t = None
+        self._finish_t = None
+
+    def wait(self, timeout=None):
+        """Block until the request finishes; returns prompt + generated ids."""
+        if not self.finished.wait(timeout):
+            raise TimeoutError(
+                f"generation not finished after {timeout}s "
+                f"({len(self.tokens)}/{self.max_new_tokens} tokens)"
+            )
+        if self.error is not None:
+            raise self.error
+        return np.concatenate([self.prompt, np.asarray(self.tokens, np.int32)])
+
+
+class ContinuousBatchingEngine:
+    """Slot-pooled continuous-batching engine over a causal-LM with the
+    compiled static-KV decode contract (`model.llama(toks, caches=, pos=)` +
+    `model.lm_head`, i.e. LlamaForCausalLM and shape-compatible models).
+
+    submit() enqueues (bounded admission queue -> QueueFull); the scheduler —
+    either the background thread started by start()/serve(), or synchronous
+    step()/run_until_idle() calls — admits queued requests into free slots
+    via bucketed prefill and advances all active slots one token per decode
+    step.  Tokens stream through per-request `on_token` callbacks as they are
+    produced.
+    """
+
+    def __init__(self, model, slots=None, max_len=None, prefill_buckets=None,
+                 queue_depth=None, seed=0):
+        import jax
+
+        from .. import jit, to_tensor
+
+        cfg = model.config
+        self.model = model
+        self.slots = int(slots if slots is not None else _fcore.flag("FLAGS_serve_slots"))
+        max_len = max_len if max_len is not None else cfg.max_position_embeddings
+        # rope tables (and therefore positions) top out at max_position_embeddings
+        self.max_len = int(min(max_len, cfg.max_position_embeddings))
+        if prefill_buckets is None:
+            raw = str(_fcore.flag("FLAGS_serve_prefill_buckets"))
+            prefill_buckets = [int(x) for x in raw.split(",") if x.strip()]
+        self.prefill_buckets = sorted(
+            {int(b) for b in prefill_buckets if 0 < int(b) < self.max_len}
+        )
+        if not self.prefill_buckets:
+            raise ValueError("prefill_buckets must contain a value < max_len")
+        self.queue_depth = int(
+            queue_depth if queue_depth is not None else _fcore.flag("FLAGS_serve_queue_depth")
+        )
+
+        # generation is inference: dropout must not bake into the cached
+        # executables (they outlive any later train() switch)
+        if getattr(model, "training", False):
+            model.eval()
+
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        cache_dtype = model.lm_head.weight.dtype  # bf16 under AMP-O2 decorate
+        self._caches = [
+            StaticKVCache(self.slots, self.max_len, cfg.num_key_value_heads,
+                          head_dim, cache_dtype)
+            for _ in range(cfg.num_hidden_layers)
+        ]
+        self._decode_fn = jit.to_static(self._decode_body)
+        self._prefill_fn = jit.to_static(self._prefill_body)
+        self._key = to_tensor(np.asarray(jax.random.PRNGKey(int(seed))))
+
+        # host-side slot table — touched only by the scheduling thread
+        self._slot_req = [None] * self.slots
+        self._pos = np.zeros(self.slots, np.int32)
+        self._last_tok = np.zeros(self.slots, np.int32)
+        self._temps = np.zeros(self.slots, np.float32)
+        # device-resident decode loop state (toks, pos, active, temps),
+        # rebuilt from the host mirrors only when slot membership changes
+        self._dev = None
+        # decode steps dispatched but not yet fetched to host: [(nxt, idx)]
+        self._pending_fetch = []
+
+        self._queue = queue.Queue(maxsize=self.queue_depth)
+        self._cv = threading.Condition()
+        self._thread = None
+        self._stop = False
+
+    # -- compiled bodies ----------------------------------------------------
+
+    def _decode_body(self, toks, pos, active, temps, key):
+        """One token for every slot: toks [S,1], pos [S], active [S] bool,
+        temps [S] f32 (0 = greedy, >0 = sampled — per-slot, as data), key
+        uint32[2].  Inactive slots run at pos 0 (scratch, see module doc).
+        Returns (next tokens [S,1], advanced pos [S], key): the loop state is
+        device-resident and threads straight back in — between membership
+        changes a decode step costs one executable dispatch plus the [S]
+        token fetch, zero host->device transfers."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.dispatch import apply
+
+        pos_eff = apply(
+            lambda p, a: jnp.where(a, p, 0), [pos, active], name="serve_pos_mask"
+        )
+        hidden, _ = self.model.llama(toks, caches=self._caches, pos=pos_eff)
+        logits = self.model.lm_head(hidden)[:, -1]  # [S, V]
+
+        def f(lg, ky, tp, p, a):
+            lgf = lg.astype(jnp.float32)
+            greedy = jnp.argmax(lgf, axis=-1).astype(jnp.int32)
+            ky, sub = jax.random.split(ky)
+            samp = jax.random.categorical(
+                sub, lgf / jnp.maximum(tp, 1e-6)[:, None], axis=-1
+            ).astype(jnp.int32)
+            nxt = jnp.where(tp > 0.0, samp, greedy)
+            return nxt[:, None], jnp.where(a, p + 1, p), ky
+
+        nxt, new_pos, key = apply(
+            f, [logits, key, temps, pos, active], multi=True, name="serve_sample"
+        )
+        return nxt, new_pos, key
+
+    def _prefill_body(self, toks, slot, true_len, temp, key):
+        """Bucketed prefill: toks [1, bucket] (right-padded), slot / true_len
+        scalars (data).  Writes K/V into pool rows [0, bucket) of `slot` and
+        returns the first generated token from the logits at true_len - 1."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..ops.dispatch import apply
+
+        views = [SlotView(c, slot) for c in self._caches]
+        hidden, _ = self.model.llama(toks, caches=views)
+        h_last = apply(
+            lambda h, n: lax.dynamic_slice_in_dim(h, n - 1, 1, 1),
+            [hidden, true_len], name="serve_prefill_last",
+        )
+        logits = self.model.lm_head(h_last)[:, -1]  # [1, V]
+
+        def f(lg, ky, tp):
+            lgf = lg.astype(jnp.float32)
+            greedy = jnp.argmax(lgf, axis=-1).astype(jnp.int32)
+            ky, sub = jax.random.split(ky)
+            samp = jax.random.categorical(
+                sub, lgf / jnp.maximum(tp, 1e-6), axis=-1
+            ).astype(jnp.int32)
+            return jnp.where(tp > 0.0, samp, greedy), ky
+
+        nxt, key = apply(f, [logits, key, temp], multi=True, name="serve_sample1")
+        return nxt, key
+
+    # -- public API ---------------------------------------------------------
+
+    def submit(self, input_ids, max_new_tokens=32, temperature=0.0,
+               eos_token_id=None, on_token=None):
+        """Enqueue one request (1-D token ids).  Returns an EngineRequest
+        handle immediately; raises QueueFull when the admission queue is at
+        capacity."""
+        ids = np.asarray(input_ids, np.int32).reshape(-1)
+        if ids.size == 0:
+            raise ValueError("empty prompt")
+        if ids.size >= self.max_len:
+            raise ValueError(
+                f"prompt length {ids.size} >= engine max_len {self.max_len}"
+            )
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        req = EngineRequest(ids, max_new_tokens, temperature, eos_token_id, on_token)
+        req._submit_t = time.perf_counter()
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            raise QueueFull(
+                f"admission queue full ({self.queue_depth} pending)"
+            ) from None
+        with self._cv:
+            self._cv.notify()
+        return req
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+                 eos_token_id=None, timeout=None):
+        """Submit + wait.  Drives the scheduler inline when no background
+        thread is running; returns prompt + generated ids (np.int32)."""
+        req = self.submit(input_ids, max_new_tokens=max_new_tokens,
+                          temperature=temperature, eos_token_id=eos_token_id)
+        if self._thread is None:
+            self.run_until_idle()
+        return req.wait(timeout)
+
+    def warmup(self):
+        """Trace/compile (or AOT-load via FLAGS_compile_cache_dir) every
+        prefill bucket and the decode step before traffic arrives.  Dummy
+        data through the real executables; the rows it scribbles into slot 0
+        are rewritten by that slot's next real prefill.  Call before start().
+        """
+        from .. import to_tensor
+
+        for b in self.prefill_buckets:
+            _, self._key = self._prefill_fn(
+                to_tensor(np.zeros((1, b), np.int32)),
+                to_tensor(np.int32(0)), to_tensor(np.int32(b)),
+                to_tensor(np.float32(0.0)), self._key,
+            )
+        _, _, self._key = self._decode_fn(
+            to_tensor(np.zeros((self.slots, 1), np.int32)),
+            to_tensor(np.zeros(self.slots, np.int32)),
+            to_tensor(np.zeros(self.slots, bool)),
+            to_tensor(np.zeros(self.slots, np.float32)),
+            self._key,
+        )
+        return self
+
+    def compile_counts(self):
+        """{prefill, decode} trace counts + AOT snapshot hits — the test
+        contract is prefill == len(buckets used) and decode == 1, forever."""
+        return {
+            "prefill": self._prefill_fn.trace_count,
+            "decode": self._decode_fn.trace_count,
+            "aot_hits": self._prefill_fn.aot_hits + self._decode_fn.aot_hits,
+        }
+
+    @property
+    def active_slots(self):
+        return sum(1 for r in self._slot_req if r is not None)
+
+    @property
+    def pending(self):
+        return self._queue.qsize()
+
+    # -- scheduler ----------------------------------------------------------
+
+    def step(self):
+        """One scheduling tick: admit queued requests into free slots
+        (bucketed prefill), then advance every active slot one token.
+        Returns the number of tokens emitted (prefill first-tokens included).
+        Synchronous alternative to start() — never mix the two."""
+        emitted = self._admit()
+        return emitted + self._decode_once()
+
+    def run_until_idle(self):
+        """Drive step() until queue and slots are empty (synchronous mode)."""
+        total = 0
+        while self._queue.qsize() or self.active_slots:
+            total += self.step()
+        return total
+
+    def start(self):
+        """Run the scheduler on a daemon thread (serve() calls this)."""
+        if self._thread is not None:
+            return self
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, name="cb-engine", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._thread is None:
+            return
+        self._stop = True
+        with self._cv:
+            self._cv.notify_all()
+        self._thread.join(timeout=30)
+        self._thread = None
+
+    def _loop(self):
+        while not self._stop:
+            if not self._queue.qsize() and not self.active_slots:
+                with self._cv:
+                    if not self._stop and not self._queue.qsize():
+                        self._cv.wait(timeout=0.05)
+                continue
+            try:
+                self.step()
+            except Exception as e:  # poison every in-flight request, keep serving
+                self._pending_fetch.clear()
+                for s, req in enumerate(self._slot_req):
+                    if req is not None:
+                        req.error = e
+                        self._finish(s, req, "error")
+
+    # -- internals ----------------------------------------------------------
+
+    def _bucket_for(self, n):
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        # over-bucket prompt: grow a next-power-of-two bucket (one extra
+        # compile, then cached/snapshotted like any other)
+        b = min(1 << (n - 1).bit_length(), self.max_len - 1)
+        self.prefill_buckets.append(b)
+        self.prefill_buckets.sort()
+        return b
+
+    def _admit(self):
+        emitted = 0
+        for s in range(self.slots):
+            if self._slot_req[s] is not None:
+                continue
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            try:
+                self._prefill_into(s, req)
+                emitted += 1
+            except Exception as e:  # fail THIS request, keep the engine alive
+                req.error = e
+                if self._slot_req[s] is req:
+                    self._finish(s, req, "error")
+                else:
+                    req.finish_reason = "error"
+                    req.finished.set()
+        return emitted
+
+    def _prefill_into(self, s, req):
+        from .. import to_tensor
+
+        # the rebuild after this membership change reads _last_tok — it must
+        # reflect every step already dispatched
+        self._flush_pending()
+        L = int(req.prompt.size)
+        bucket = self._bucket_for(L)
+        # cache rows run out at max_len: the last writable decode row is
+        # max_len - 1, giving max_len - L generatable tokens
+        req.max_new_tokens = min(req.max_new_tokens, self.max_len - L)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :L] = req.prompt
+        nxt, self._key = self._prefill_fn(
+            to_tensor(toks), to_tensor(np.int32(s)), to_tensor(np.int32(L)),
+            to_tensor(np.float32(req.temperature)), self._key,
+        )
+        tok = int(np.asarray(nxt.numpy()).reshape(-1)[0])
+        req.ttft_s = time.perf_counter() - req._submit_t
+        self._slot_req[s] = req
+        self._pos[s] = L
+        self._last_tok[s] = tok
+        self._temps[s] = req.temperature
+        self._dev = None  # membership changed: rebuild device loop state
+        self._emit(s, req, tok)
+
+    def _decode_once(self):
+        from .. import profiler as _prof
+        from .. import to_tensor
+
+        active_idx = [s for s in range(self.slots) if self._slot_req[s] is not None]
+        if not active_idx:
+            return 0
+        t0 = time.perf_counter()
+        if self._dev is None:
+            active = np.zeros(self.slots, bool)
+            active[active_idx] = True
+            self._dev = (
+                to_tensor(self._last_tok.reshape(self.slots, 1)),
+                to_tensor(self._pos.copy()), to_tensor(active),
+                to_tensor(self._temps.copy()),
+            )
+        toks_t, pos_t, active_t, temps_t = self._dev
+        nxt, new_pos, self._key = self._decode_fn(
+            toks_t, pos_t, active_t, temps_t, self._key
+        )
+        self._dev = (nxt, new_pos, active_t, temps_t)
+        for s in active_idx:
+            self._pos[s] += 1
+        # fetch to host only when something needs the values this step — a
+        # per-token consumer (EOS watch, streaming callback) or a slot hitting
+        # its length bound.  Otherwise the step stays in flight and the sync
+        # lands at the next membership change, so XLA pipelines decode
+        # dispatches exactly like the lock-step generate loop.
+        self._pending_fetch.append((nxt, active_idx))
+        depth = len(self._pending_fetch)
+        if any(
+            self._slot_req[s].eos_token_id is not None
+            or self._slot_req[s].on_token is not None
+            or len(self._slot_req[s].tokens) + depth
+            >= self._slot_req[s].max_new_tokens
+            for s in active_idx
+        ):
+            self._flush_pending()
+        _prof.record_serving_tick(
+            len(active_idx) / self.slots, self._queue.qsize(),
+            time.perf_counter() - t0,
+        )
+        return len(active_idx)
+
+    def _flush_pending(self):
+        """Fetch every dispatched-but-unfetched decode step and emit its
+        tokens.  Membership is constant across buffered steps (any change
+        flushes first), so each entry's active set is exact."""
+        if not self._pending_fetch:
+            return
+        batches, self._pending_fetch = self._pending_fetch, []
+        for nxt, idx in batches:
+            nxt_np = np.asarray(nxt.numpy()).reshape(-1)
+            for s in idx:
+                req = self._slot_req[s]
+                if req is None:  # finished earlier in this flush
+                    continue
+                tok = int(nxt_np[s])
+                self._last_tok[s] = tok
+                self._emit(s, req, tok)
+
+    def _emit(self, s, req, tok):
+        req.tokens.append(tok)
+        if req.on_token is not None:
+            try:
+                req.on_token(tok)
+            except Exception:
+                pass  # a broken consumer must not take the engine down
+        if req.eos_token_id is not None and tok == req.eos_token_id:
+            self._finish(s, req, "eos")
+        elif len(req.tokens) >= req.max_new_tokens:
+            self._finish(s, req, "length")
+
+    def _finish(self, s, req, reason):
+        from .. import profiler as _prof
+
+        req.finish_reason = reason
+        req._finish_t = time.perf_counter()
+        # recycle immediately: no cache scrub needed — the slot's next
+        # prefill overwrites rows [0, bucket) and decode masks the rest
+        self._slot_req[s] = None
+        self._pos[s] = 0
+        self._last_tok[s] = 0
+        self._temps[s] = 0.0
+        self._dev = None  # membership changed: rebuild device loop state
+        if reason != "error":
+            _prof.record_serving_request(
+                req.ttft_s or 0.0, len(req.tokens),
+                req._finish_t - req._submit_t,
+            )
+        req.finished.set()

@@ -97,6 +97,40 @@ def _sample_from_logits(logits, key, temp, top_k, top_p):
     return apply(f, [logits, key, temp], multi=True, name="sample_from_logits")
 
 
+def _mask_eos(nxt, done, eos):
+    """EOS bookkeeping traced INTO the compiled step: rows already done keep
+    emitting eos (so the executable is oblivious to which rows finished —
+    done is data, never a shape), and done absorbs rows that just hit eos.
+    nxt: [b, 1] tokens; done: [b] bool."""
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import apply
+
+    def f(n, d):
+        n = jnp.where(d[:, None], jnp.asarray(eos, n.dtype), n)
+        d = d | (n[:, 0] == eos)
+        return n, d
+
+    return apply(f, [nxt, done], multi=True, name="eos_mask")
+
+
+def _trim_eos(out, s0, eos):
+    """Right-trim generated columns past the last sequence's EOS (finished
+    rows are eos-padded by _mask_eos up to the trim point)."""
+    from .. import to_tensor
+
+    arr = np.asarray(out.numpy())
+    gen = arr[:, s0:]
+    if gen.shape[1] == 0:
+        return out
+    is_eos = gen == eos
+    lens = np.where(is_eos.any(1), is_eos.argmax(1) + 1, gen.shape[1])
+    keep = int(lens.max())
+    if keep == gen.shape[1]:
+        return out
+    return to_tensor(arr[:, : s0 + keep])
+
+
 def _gather_rows(t, rows):
     """t[rows] along axis 0 (beam cache/state reorder)."""
     from ..ops.dispatch import apply
@@ -201,15 +235,37 @@ def compiled_generate(model, input_ids, max_new_tokens, temperature, forward_ste
     try:
         with no_grad():
             pos0 = to_tensor(np.int32(0))
+            eos = None if eos_token_id is None else int(eos_token_id)
             if decode_strategy == "greedy_search":
-                step = _get("greedy", _greedy_step)
+                if eos is None:
+                    step = _get("greedy", _greedy_step)
+                    pieces = [input_ids]
+                    nxt, pos = step(input_ids, pos0)
+                    pieces.append(nxt)
+                    for _ in range(1, max_new_tokens):
+                        nxt, pos = step(nxt, pos)
+                        pieces.append(nxt)
+                    return ops.concat(pieces, axis=1)
+
+                def _greedy_eos_step(toks, pos, done):
+                    nxt, pos = _greedy_step(toks, pos)
+                    nxt, done = _mask_eos(nxt, done, eos)
+                    return nxt, pos, done
+
+                step = _get(("greedy", eos), _greedy_eos_step)
+                done = to_tensor(np.zeros((b,), bool))
                 pieces = [input_ids]
-                nxt, pos = step(input_ids, pos0)
+                nxt, pos, done = step(input_ids, pos0, done)
                 pieces.append(nxt)
                 for _ in range(1, max_new_tokens):
-                    nxt, pos = step(nxt, pos)
+                    # the all-done check syncs once per token — the price of
+                    # stopping early; rows that finished sooner ride along
+                    # emitting eos until the LAST row finishes
+                    if bool(done.numpy().all()):
+                        break
+                    nxt, pos, done = step(nxt, pos, done)
                     pieces.append(nxt)
-                return ops.concat(pieces, axis=1)
+                return _trim_eos(ops.concat(pieces, axis=1), s0, eos)
 
             if decode_strategy == "sampling":
                 def _sample_step(toks, pos, key, temp):
@@ -217,18 +273,36 @@ def compiled_generate(model, input_ids, max_new_tokens, temperature, forward_ste
                     nxt, key = _sample_from_logits(logits, key, temp, top_k, top_p)
                     return nxt.astype(token_dtype), pos + toks.shape[1], key
 
-                step = _get(("sample", top_k, top_p), _sample_step)
                 if seed is None:
                     seed = int(np.random.randint(0, 2**31 - 1))
                 key = to_tensor(np.asarray(jax.random.PRNGKey(seed)))
                 temp = to_tensor(np.float32(temperature))
+                if eos is None:
+                    step = _get(("sample", top_k, top_p), _sample_step)
+                    pieces = [input_ids]
+                    nxt, pos, key = step(input_ids, pos0, key, temp)
+                    pieces.append(nxt)
+                    for _ in range(1, max_new_tokens):
+                        nxt, pos, key = step(nxt, pos, key, temp)
+                        pieces.append(nxt)
+                    return ops.concat(pieces, axis=1)
+
+                def _sample_eos_step(toks, pos, key, temp, done):
+                    nxt, pos, key = _sample_step(toks, pos, key, temp)
+                    nxt, done = _mask_eos(nxt, done, eos)
+                    return nxt, pos, key, done
+
+                step = _get(("sample", top_k, top_p, eos), _sample_eos_step)
+                done = to_tensor(np.zeros((b,), bool))
                 pieces = [input_ids]
-                nxt, pos, key = step(input_ids, pos0, key, temp)
+                nxt, pos, key, done = step(input_ids, pos0, key, temp, done)
                 pieces.append(nxt)
                 for _ in range(1, max_new_tokens):
-                    nxt, pos, key = step(nxt, pos, key, temp)
+                    if bool(done.numpy().all()):
+                        break
+                    nxt, pos, key, done = step(nxt, pos, key, temp, done)
                     pieces.append(nxt)
-                return ops.concat(pieces, axis=1)
+                return _trim_eos(ops.concat(pieces, axis=1), s0, eos)
 
             # ---- beam search ------------------------------------------------
             return _beam_search(

@@ -90,9 +90,11 @@ def _rope_cache(config):
 
 def apply_rotary_pos_emb(q, k, cos, sin, position_offset=0):
     """q,k: [b, s, h, d]; cos/sin: [max_pos, d] state tensors (rotate-half).
-    position_offset may be a python int or a scalar int Tensor (the compiled
+    position_offset may be a python int, a scalar int Tensor (the compiled
     decode step passes the position as data so one executable serves every
-    token)."""
+    token), or a [b] int Tensor of PER-ROW offsets (the continuous-batching
+    engine's slot pool: every slot sits at its own position, still one
+    executable)."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -100,9 +102,16 @@ def apply_rotary_pos_emb(q, k, cos, sin, position_offset=0):
 
     s = q.shape[1]
     dyn = isinstance(position_offset, Tensor)
+    per_row = dyn and len(position_offset.shape) == 1
 
     def f(qa, ka, c, si, *off_in):
-        if off_in:
+        if off_in and per_row:
+            # per-slot offsets: gather each row's cos/sin window (jax gather
+            # clamps out-of-range, matching the cache-bounds contract)
+            idx = off_in[0][:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+            c = c[idx][:, :, None, :].astype(qa.dtype)        # [b, s, 1, d]
+            si_ = si[idx][:, :, None, :].astype(qa.dtype)
+        elif off_in:
             # traced offset (compiled decode): cache bounds guarantee
             # off + s <= max_pos, so the dynamic slice never clamps
             c = lax.dynamic_slice_in_dim(c, off_in[0], s, 0)
@@ -111,8 +120,9 @@ def apply_rotary_pos_emb(q, k, cos, sin, position_offset=0):
             # static offset: plain slicing keeps the out-of-range case loud
             c = c[position_offset : position_offset + s]
             si_ = si[position_offset : position_offset + s]
-        c = c[None, :, None, :].astype(qa.dtype)
-        si_ = si_[None, :, None, :].astype(qa.dtype)
+        if not (off_in and per_row):
+            c = c[None, :, None, :].astype(qa.dtype)
+            si_ = si_[None, :, None, :].astype(qa.dtype)
 
         def rot(x):
             half = x.shape[-1] // 2
@@ -144,15 +154,53 @@ class StaticKVCache:
 
 
 def _cache_write(cache_t, new_t, pos_t):
-    """dynamic_update_slice of this chunk's K or V at the absolute position."""
+    """dynamic_update_slice of this chunk's K or V at the absolute position.
+    pos may be a scalar (lock-step decode: whole batch at one position) or a
+    [b] vector (slot-pooled decode: each slot writes at its own position)."""
+    import jax
+
     from jax import lax
 
     from ..ops.dispatch import apply
 
+    per_row = len(pos_t.shape) == 1 if isinstance(pos_t, Tensor) else False
+
     def f(c, n, p):
+        if per_row:
+            return jax.vmap(
+                lambda cb, nb, pb: lax.dynamic_update_slice_in_dim(
+                    cb, nb.astype(cb.dtype), pb, 0
+                )
+            )(c, n, p)
         return lax.dynamic_update_slice_in_dim(c, n.astype(c.dtype), p, 1)
 
     return apply(f, [cache_t, new_t, pos_t], name="kv_cache_write")
+
+
+class SlotView:
+    """Write-only view of ONE slot of a pooled StaticKVCache, used by the
+    continuous-batching engine's compiled prefill: the prompt's K/V land in
+    rows [0, bucket) of pool row `slot` (a scalar int Tensor — data, not a
+    shape), while attention runs over the fresh prompt only.  Rows beyond the
+    true prompt length hold padding garbage; they are safe because decode
+    overwrites row `pos` before ever attending to it and masks j > pos."""
+
+    def __init__(self, pool, slot):
+        self.pool = pool
+        self.slot = slot
+
+
+def _slot_write(pool_t, new_t, slot_t):
+    """Write a [1, s, kv_heads, d] chunk into rows [0, s) of pool slot
+    `slot_t` ([slots, max_len, kv_heads, d] buffer; slot index is data)."""
+    from jax import lax
+
+    from ..ops.dispatch import apply
+
+    def f(c, n, s_):
+        return lax.dynamic_update_slice(c, n.astype(c.dtype), (s_, 0, 0, 0))
+
+    return apply(f, [pool_t, new_t, slot_t], name="kv_slot_write")
 
 
 class LlamaMLP(nn.Layer):
@@ -198,6 +246,17 @@ class LlamaAttention(nn.Layer):
         q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
         k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        if isinstance(cache, SlotView):
+            # compiled prefill into a pooled cache: the prompt attends to
+            # itself (plain causal attention) while its K/V are written into
+            # rows [0, s) of the assigned pool slot — slot index is data, so
+            # one executable per prompt bucket serves every slot
+            q, k = apply_rotary_pos_emb(q, k, self.rope_cos, self.rope_sin, 0)
+            cache.pool.k._data = _slot_write(cache.pool.k, k, cache.slot)._data
+            cache.pool.v._data = _slot_write(cache.pool.v, v, cache.slot)._data
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+            out = out.reshape([b, s, self.num_heads * self.head_dim])
+            return self.o_proj(out), cache
         if isinstance(cache, StaticKVCache):
             # compiled decode path: fixed-shape cache, position as data;
             # cache validity rides the flash_decode kernel (in-kernel

@@ -653,11 +653,16 @@ def decode_attention_array(q, k, v, pos, scale=None):
 
     q: [b, sq, h, d] (the fresh chunk); k,v: [b, L, kv_h, d] cache buffers
     (every slot, written or not); pos: scalar int32 — absolute position of
-    q row 0.  Row i attends cache slots j <= pos + i.  Pallas on TPU (or
-    under interpret); a fused dense XLA path elsewhere — both take validity
-    from `pos`, never from a mask array.
+    q row 0 — or int32[b] PER-BATCH-ROW positions (the continuous-batching
+    slot pool: each slot decodes at its own length, still one executable).
+    Row i attends cache slots j <= pos + i.  Pallas on TPU (or under
+    interpret); a fused dense XLA path elsewhere — both take validity from
+    `pos`, never from a mask array.  Vector pos always takes the dense path
+    (single-token decode is its domain and the dense matvec is the optimal
+    lowering there anyway).
     """
     b, sq, h, d = q.shape
+    per_row_pos = jnp.ndim(pos) == 1
     L = k.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
@@ -673,7 +678,13 @@ def decode_attention_array(q, k, v, pos, scale=None):
     # flash-decode for q=1 (measured: Pallas per-layer launches cost ~30%
     # of decode tok/s).  The Pallas kernel wins for prefill-with-cache,
     # where it avoids materializing the [sq, L] score block.
-    if (_on_tpu() or interpret) and d <= 256 and L % 128 == 0 and sq >= 64:
+    if (
+        (_on_tpu() or interpret)
+        and not per_row_pos
+        and d <= 256
+        and L % 128 == 0
+        and sq >= 64
+    ):
         # pad q rows up to the TPU sublane tile; padded rows attend slot 0+
         # legitimately (their q_ids exceed the real rows') and are sliced off
         sq_pad = -(-sq // 8) * 8 if sq <= 256 else -(-sq // 128) * 128
@@ -701,7 +712,12 @@ def decode_attention_array(q, k, v, pos, scale=None):
     s = jnp.einsum(
         "bgrqd,bgkd->bgrqk", q5, kt, preferred_element_type=jnp.float32
     ) * scale
-    q_ids = pos + jax.lax.broadcasted_iota(jnp.int32, (sq, L), 0)
+    iota_q = jax.lax.broadcasted_iota(jnp.int32, (sq, L), 0)
+    if per_row_pos:
+        # [b, 1, 1, sq, L] broadcast against s [b, g, r, sq, L]
+        q_ids = pos.reshape(b, 1, 1, 1, 1) + iota_q
+    else:
+        q_ids = pos + iota_q
     k_ids = jax.lax.broadcasted_iota(jnp.int32, (sq, L), 1)
     s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)

@@ -112,6 +112,84 @@ def step_breakdown():
     return out
 
 
+# ---------------------------------------------------------------------------
+# Serving gauges (ISSUE 5): the continuous-batching engine reports one tick
+# per decode step (slot occupancy at that instant + admission-queue depth)
+# and one record per finished request (TTFT, generated tokens, wall time from
+# submit to finish).  tokens/s here is aggregate throughput over the engine's
+# busy window, the number the ≥1.5x-vs-lock-step acceptance gate checks.
+# ---------------------------------------------------------------------------
+
+_TTFT_KEEP = 10000  # bound the percentile buffer; serving runs are long
+
+_serving_gauges = {
+    "requests": 0,
+    "tokens": 0,
+    "ttfts_s": [],
+    "busy_s": 0.0,
+    "ticks": 0,
+    "occupancy_sum": 0.0,
+    "queue_depth_sum": 0,
+    "queue_depth_max": 0,
+}
+
+
+def record_serving_request(ttft_s, tokens, wall_s):
+    """One finished generation request: time-to-first-token, tokens emitted,
+    submit->finish wall time."""
+    g = _serving_gauges
+    g["requests"] += 1
+    g["tokens"] += int(tokens)
+    g["ttfts_s"].append(float(ttft_s))
+    if len(g["ttfts_s"]) > _TTFT_KEEP:
+        del g["ttfts_s"][: -_TTFT_KEEP]
+
+
+def record_serving_tick(occupancy, queue_depth, busy_s=0.0):
+    """One engine decode step: fraction of slots active, queued requests,
+    and the step's wall time (summed into the busy window for tokens/s)."""
+    g = _serving_gauges
+    g["ticks"] += 1
+    g["occupancy_sum"] += float(occupancy)
+    g["queue_depth_sum"] += int(queue_depth)
+    g["busy_s"] += float(busy_s)
+    if queue_depth > g["queue_depth_max"]:
+        g["queue_depth_max"] = int(queue_depth)
+
+
+def reset_serving():
+    g = _serving_gauges
+    g.update(
+        requests=0, tokens=0, ttfts_s=[], busy_s=0.0, ticks=0,
+        occupancy_sum=0.0, queue_depth_sum=0, queue_depth_max=0,
+    )
+
+
+def _pctl(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def serving_summary():
+    """Aggregated serving metrics: requests, tokens, aggregate tokens/s over
+    the busy window, TTFT p50/p95, mean slot occupancy, queue depth avg/max."""
+    g = _serving_gauges
+    out = {"requests": g["requests"], "tokens": g["tokens"]}
+    if g["busy_s"] > 0:
+        out["tokens_per_s"] = g["tokens"] / g["busy_s"]
+    ttfts = sorted(g["ttfts_s"])
+    if ttfts:
+        out["ttft_p50_ms"] = _pctl(ttfts, 0.50) * 1e3
+        out["ttft_p95_ms"] = _pctl(ttfts, 0.95) * 1e3
+    if g["ticks"]:
+        out["occupancy_mean"] = g["occupancy_sum"] / g["ticks"]
+        out["queue_depth_avg"] = g["queue_depth_sum"] / g["ticks"]
+        out["queue_depth_max"] = g["queue_depth_max"]
+    return out
+
+
 class RecordEvent:
     """Host-span annotation; shows up in the XPlane host timeline
     (reference: platform::RecordEvent)."""
@@ -229,6 +307,20 @@ class Profiler:
                 "  host-blocked {host_blocked_ms_avg:.3f} ms"
                 "  device(est) {device_ms_avg_est:.3f} ms"
                 "  inflight avg {inflight_depth_avg:.2f} max {inflight_depth_max}".format(**bd)
+            )
+        sv = serving_summary()
+        if sv["requests"]:
+            print(
+                "serving: {requests} requests  {tokens} tokens"
+                "  {tok_s:.0f} tok/s  ttft p50 {p50:.1f} ms p95 {p95:.1f} ms"
+                "  occupancy {occ:.2f}  queue avg {qa:.1f} max {qm}".format(
+                    requests=sv["requests"], tokens=sv["tokens"],
+                    tok_s=sv.get("tokens_per_s", 0.0),
+                    p50=sv.get("ttft_p50_ms", 0.0), p95=sv.get("ttft_p95_ms", 0.0),
+                    occ=sv.get("occupancy_mean", 0.0),
+                    qa=sv.get("queue_depth_avg", 0.0),
+                    qm=sv.get("queue_depth_max", 0),
+                )
             )
         # compile caches dominate cold-start cost: surface them next to the
         # step timing so "why was the first step slow" is answerable here

@@ -1,0 +1,285 @@
+"""Continuous-batching engine (ISSUE 5): slot-pooled static KV cache, one
+compiled decode step for every occupancy, bucketed prefill, slot recycling
+without leakage, EOS handling, and the serve() admission-queue contract.
+
+All CPU: the engine's decode rides the dense flash_decode path (sq=1), the
+same executable shape as TPU minus the Pallas kernel choice.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference
+from paddle_tpu.inference.engine import ContinuousBatchingEngine, QueueFull
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="module")
+def model():
+    np.random.seed(1234)
+    return LlamaForCausalLM(LlamaConfig.tiny())
+
+
+def _prompt(n, seed=0):
+    return np.random.RandomState(seed).randint(1, 250, size=n).astype(np.int32)
+
+
+def _engine(model, **kw):
+    kw.setdefault("slots", 3)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prefill_buckets", [8, 16])
+    kw.setdefault("queue_depth", 16)
+    kw.setdefault("seed", 0)
+    return ContinuousBatchingEngine(model, **kw)
+
+
+def _post(port, body, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        json.dumps(body).encode(),
+        {"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# ---------------------------------------------------------------------------
+# correctness: engine vs lock-step generate
+# ---------------------------------------------------------------------------
+
+
+def test_engine_matches_lockstep_generate(model):
+    p = _prompt(5, seed=7)
+    eng = _engine(model)
+    out = eng.generate(p, max_new_tokens=6)
+    ref = model.generate(
+        paddle.to_tensor(p[None]), max_new_tokens=6
+    ).numpy()[0]
+    assert np.array_equal(out, ref)
+
+
+def test_slot_recycling_no_leakage(model):
+    """A slot recycled from finished request A must give request B the exact
+    tokens a fresh engine (and the lock-step path) gives: the stale rows A
+    left beyond B's prefill are never attended (decode overwrites row pos
+    before masking j <= pos)."""
+    pa, pb = _prompt(14, seed=1), _prompt(5, seed=2)
+    dirty = _engine(model, slots=1)  # one slot: B MUST reuse A's slot
+    ra = dirty.submit(pa, max_new_tokens=20)  # long: fills rows well past B's
+    dirty.run_until_idle()
+    ra.wait(1)
+    out_dirty = dirty.generate(pb, max_new_tokens=8)
+
+    fresh = _engine(model, slots=1)
+    out_fresh = fresh.generate(pb, max_new_tokens=8)
+    assert np.array_equal(out_dirty, out_fresh)
+
+    ref = model.generate(paddle.to_tensor(pb[None]), max_new_tokens=8).numpy()[0]
+    assert np.array_equal(out_dirty, ref)
+
+
+def test_per_slot_temperature_is_data(model):
+    """A sampled request decoding next to a greedy one must not perturb the
+    greedy tokens (temperature is per-slot data; rows are independent)."""
+    pg, ps = _prompt(5, seed=3), _prompt(9, seed=4)
+    eng = _engine(model)
+    rg = eng.submit(pg, max_new_tokens=6, temperature=0.0)
+    rs = eng.submit(ps, max_new_tokens=6, temperature=0.9)
+    eng.run_until_idle()
+    ref = model.generate(paddle.to_tensor(pg[None]), max_new_tokens=6).numpy()[0]
+    assert np.array_equal(rg.wait(1), ref)
+    assert len(rs.wait(1)) == 9 + 6
+
+
+# ---------------------------------------------------------------------------
+# compile-count contract: buckets + 1, zero recompiles after warmup
+# ---------------------------------------------------------------------------
+
+
+def test_mixed_length_compile_count(model):
+    """Total compiled executables == distinct prefill buckets used + 1
+    decode, across joins, finishes, and recycling."""
+    eng = _engine(model, slots=2, prefill_buckets=[8, 16, 32])
+    lens = [5, 12, 20, 3, 30, 8]  # buckets 8, 16, 32, 8, 32, 8
+    reqs = [
+        eng.submit(_prompt(n, seed=10 + i), max_new_tokens=4 + (i % 3))
+        for i, n in enumerate(lens)
+    ]
+    eng.run_until_idle()
+    for r in reqs:
+        r.wait(1)
+    counts = eng.compile_counts()
+    assert counts["prefill"] == 3  # buckets 8, 16, 32 each traced once
+    assert counts["decode"] == 1
+
+
+def test_zero_recompiles_after_warmup(model):
+    eng = _engine(model)
+    eng.warmup()
+    warm = eng.compile_counts()
+    assert warm["prefill"] == len(eng.prefill_buckets)
+    assert warm["decode"] == 1
+    # overlapping traffic with different lengths, finishes, recycling
+    reqs = [
+        eng.submit(_prompt(3 + 2 * i, seed=20 + i), max_new_tokens=2 + i)
+        for i in range(5)
+    ]
+    eng.run_until_idle()
+    for r in reqs:
+        assert r.wait(1) is not None
+        assert r.finish_reason == "length"
+    assert eng.compile_counts() == warm  # 0 recompiles under traffic
+
+
+# ---------------------------------------------------------------------------
+# EOS satellite: per-sequence stop + right-trimmed outputs
+# ---------------------------------------------------------------------------
+
+
+def test_generate_eos_stops_and_trims(model):
+    p = _prompt(5, seed=5)[None]
+    full = model.generate(paddle.to_tensor(p), max_new_tokens=8).numpy()
+    eos = int(full[0, 5 + 2])  # greedy emits this at generation step 3
+    out = model.generate(
+        paddle.to_tensor(p), max_new_tokens=8, eos_token_id=eos
+    ).numpy()
+    assert out.shape[1] == 5 + 3  # right-trimmed at the eos column
+    assert np.array_equal(out[0], full[0, : 5 + 3])
+    assert out[0, -1] == eos
+
+
+def test_generate_eos_mixed_batch_pads_finished_rows(model):
+    p = np.stack([_prompt(5, seed=5), _prompt(5, seed=6)])
+    full = model.generate(paddle.to_tensor(p), max_new_tokens=8).numpy()
+    eos = int(full[0, 5])  # row 0 finishes on its FIRST generated token
+    assert eos not in full[1, 5:], "need a row that never emits eos"
+    out = model.generate(
+        paddle.to_tensor(p), max_new_tokens=8, eos_token_id=eos
+    ).numpy()
+    assert out.shape[1] == 5 + 8  # row 1 runs to max_new_tokens
+    assert (out[0, 5:] == eos).all()  # finished row rides along as eos
+    assert np.array_equal(out[1], full[1])
+
+
+def test_generation_predictor_forwards_eos(model):
+    p = _prompt(5, seed=5)
+    pred = inference.GenerationPredictor(model, max_new_tokens=8)
+    full = pred.generate(p)
+    eos = int(full[0, 5 + 1])
+    keep = int(np.argmax(full[0, 5:] == eos)) + 1  # first eos hit stops it
+    out = pred.generate(p, eos_token_id=eos)
+    assert out.shape[1] == 5 + keep
+    assert out[0, -1] == eos
+
+
+def test_engine_eos_finishes_slot_early(model):
+    p = _prompt(5, seed=7)
+    eng = _engine(model)
+    full = eng.generate(p, max_new_tokens=8)
+    eos = int(full[5 + 1])
+    keep = int(np.argmax(full[5:] == eos)) + 1
+    out = eng.generate(p, max_new_tokens=8, eos_token_id=eos)
+    assert out.tolist() == full[: 5 + keep].tolist()
+    # finish_reason is per-request: resubmit to inspect the handle
+    r = eng.submit(p, max_new_tokens=8, eos_token_id=eos)
+    eng.run_until_idle()
+    r.wait(1)
+    assert r.finish_reason == "eos"
+
+
+# ---------------------------------------------------------------------------
+# scheduler: streaming, admission queue, threaded serve()
+# ---------------------------------------------------------------------------
+
+
+def test_streaming_token_callbacks(model):
+    p = _prompt(5, seed=8)
+    eng = _engine(model)
+    stream = []
+    r = eng.submit(p, max_new_tokens=5, on_token=stream.append)
+    eng.run_until_idle()
+    out = r.wait(1)
+    assert stream == out[-5:].tolist()  # streamed in generation order
+
+
+def test_submit_queue_full_raises(model):
+    eng = _engine(model, queue_depth=2)  # scheduler not running
+    eng.submit(_prompt(4), max_new_tokens=2)
+    eng.submit(_prompt(4), max_new_tokens=2)
+    with pytest.raises(QueueFull):
+        eng.submit(_prompt(4), max_new_tokens=2)
+
+
+def test_serve_engine_http_roundtrip_and_503(model):
+    # queue bound >= concurrent requests: the roundtrip half must not shed
+    eng = _engine(model, slots=2, queue_depth=4)
+    eng.warmup()
+    srv = inference.serve(eng, port=0, block=False)
+    port = srv.server_address[1]
+    try:
+        # overlapping requests with different lengths all complete
+        results = {}
+
+        def hit(i, n, mnt):
+            results[i] = _post(
+                port, {"input_ids": _prompt(n, seed=30 + i).tolist(),
+                       "max_new_tokens": mnt},
+            )
+
+        ts = [
+            threading.Thread(target=hit, args=(i, 3 + 4 * i, 3 + i))
+            for i in range(4)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert sorted(s for s, _ in results.values()) == [200] * 4
+        for i, (_, body) in results.items():
+            assert len(body["tokens"]) == (3 + 4 * i) + (3 + i)
+            ref = model.generate(
+                paddle.to_tensor(_prompt(3 + 4 * i, seed=30 + i)[None]),
+                max_new_tokens=3 + i,
+            ).numpy()[0]
+            assert body["tokens"] == ref.tolist()
+
+        # freeze the scheduler, fill the admission queue, and the next
+        # request must shed with 503 + JSON error body
+        eng.stop()
+        for _ in range(eng.queue_depth):
+            eng.submit(_prompt(4), max_new_tokens=2)
+        status, body = _post(port, {"input_ids": _prompt(4).tolist(),
+                                    "max_new_tokens": 2})
+        assert status == 503
+        assert "error" in body
+        eng.start()  # drain the queued requests before shutdown
+    finally:
+        srv.shutdown()
+        eng.stop()
+
+
+def test_serving_profiler_gauges(model):
+    paddle.profiler.reset_serving()
+    eng = _engine(model, slots=2)
+    reqs = [
+        eng.submit(_prompt(4 + i, seed=40 + i), max_new_tokens=3)
+        for i in range(3)
+    ]
+    eng.run_until_idle()
+    for r in reqs:
+        r.wait(1)
+    s = paddle.profiler.serving_summary()
+    assert s["requests"] == 3
+    assert s["tokens"] == 9
+    assert s["tokens_per_s"] > 0
+    assert 0 < s["occupancy_mean"] <= 1.0
+    assert s["ttft_p50_ms"] > 0 and s["ttft_p95_ms"] >= s["ttft_p50_ms"]

@@ -279,12 +279,18 @@ def bench_lenet_eager():
         last = step()
     last.numpy()
     dt = time.perf_counter() - t0
+    value = round(n / dt, 1)
+    # regression gate (ROADMAP watch item: 65.3 -> 42.0 steps/s r04 -> r05
+    # on TPU).  Enforced only on the TPU chip — CPU CI throughput is noise.
+    gate = {"min_steps_per_sec": 55.0, "enforced": _on_tpu()}
+    gate["ok"] = (value >= gate["min_steps_per_sec"]) or not gate["enforced"]
     return {
         "metric": "lenet_eager_steps_per_sec",
-        "value": round(n / dt, 1),
+        "value": value,
         "unit": "steps/s",
         "time_to_first_step_s": round(t_first, 3),
         "compile_cache": cc_delta,
+        "gate": gate,
         "note": "dygraph (no to_static); cached per-op executables, 5.9x vs retrace",
     }
 
@@ -919,13 +925,31 @@ def main():
     except Exception:
         pass
 
+    # per-config throughput gates: a config may carry {"gate": {...,
+    # "enforced": bool, "ok": bool}}; an enforced failing gate fails the
+    # whole bench run (nonzero exit) AFTER the full matrix printed, so the
+    # numbers behind the failure are always in the output
+    gate_failures = [
+        name for name, r in configs.items()
+        if isinstance(r.get("gate"), dict)
+        and r["gate"].get("enforced")
+        and not r["gate"].get("ok")
+    ]
+
     if "--all" in sys.argv:
         print(json.dumps(headline))
         for name, r in configs.items():
             print(json.dumps({"config": name, **r}))
-        return
+    else:
+        print(json.dumps({**headline, "configs": configs}))
 
-    print(json.dumps({**headline, "configs": configs}))
+    if gate_failures:
+        for name in gate_failures:
+            print(
+                f"bench gate FAILED: {name} value {configs[name].get('value')}"
+                f" < {configs[name]['gate']}", file=sys.stderr,
+            )
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -2,18 +2,33 @@
 # Single build+test entry (reference: paddle/scripts/paddle_build.sh —
 # SURVEY.md §2.4 "CI entry").  Builds the native core, runs its gtest,
 # then the full Python suite on the 8-device CPU-sim mesh, and finally a
-# CPU smoke of the benchmark matrix.  Usage: ./ci.sh [fast|chaos]
-#   fast  — skip slow tests, stop at first failure
-#   chaos — ONLY the slow-marked fault-domain drills (gang restart,
-#           heartbeat eviction, full restart-resume), each run under a
-#           hard external timeout so a broken watchdog cannot wedge CI
+# CPU smoke of the benchmark matrix.  Usage: ./ci.sh [fast|chaos|chaos-serve]
+#   fast        — skip slow tests, stop at first failure
+#   chaos       — ONLY the slow-marked fault-domain drills (gang restart,
+#                 heartbeat eviction, full restart-resume), each run under a
+#                 hard external timeout so a broken watchdog cannot wedge CI
+#   chaos-serve — the SERVING fault-domain drills (prefill hang -> watchdog
+#                 -> warm restart, NaN isolation, SIGTERM drain, deadline
+#                 eviction), slow HTTP drill included, under a hard timeout
 set -euo pipefail
 cd "$(dirname "$0")"
 
 MODE="${1:-}"
-if [ -n "$MODE" ] && [ "$MODE" != "fast" ] && [ "$MODE" != "chaos" ]; then
-  echo "usage: ./ci.sh [fast|chaos]" >&2
+if [ -n "$MODE" ] && [ "$MODE" != "fast" ] && [ "$MODE" != "chaos" ] && [ "$MODE" != "chaos-serve" ]; then
+  echo "usage: ./ci.sh [fast|chaos|chaos-serve]" >&2
   exit 2
+fi
+
+if [ "$MODE" = "chaos-serve" ]; then
+  echo "== serving chaos suite (fault drills + slow HTTP drill, hard 15min cap) =="
+  # the drills assert the engine-level watchdog/supervisor recovery; the
+  # timeout(1) wrapper is the layer above it — a wedged restart path must
+  # fail CI, not hang it
+  timeout -k 30 900 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python -m pytest tests/test_serving_fault.py \
+      -q -p no:cacheprovider
+  echo "CHAOS-SERVE OK"
+  exit 0
 fi
 
 if [ "$MODE" = "chaos" ]; then
@@ -73,6 +88,17 @@ SERVE_TESTS=(tests/test_serving_engine.py::test_zero_recompiles_after_warmup
 [ "$MODE" != "fast" ] && SERVE_TESTS=(tests/test_serving_engine.py)
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python -m pytest "${SERVE_TESTS[@]}" -q -p no:cacheprovider
+
+echo "== serving fault drills (ISSUE 6 acceptance subset) =="
+# both tiers run the deterministic core of the serving fault domain: the
+# prefill-hang -> watchdog -> warm-restart drill (0 fresh compiles, bit-
+# identical replay) and NaN isolation; fast mode skips the rest, full mode
+# runs the whole non-slow file (the slow HTTP drill lives in chaos-serve)
+SERVE_FAULT_TESTS=(tests/test_serving_fault.py::test_prefill_hang_watchdog_restart_bit_identical
+                   tests/test_serving_fault.py::test_decode_nan_poisons_only_target_slot)
+[ "$MODE" != "fast" ] && SERVE_FAULT_TESTS=(tests/test_serving_fault.py)
+timeout -k 30 600 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python -m pytest "${SERVE_FAULT_TESTS[@]}" -q -m "not slow" -p no:cacheprovider
 
 if [ "$MODE" != "fast" ]; then
   echo "== bench smoke (CPU) =="

@@ -341,6 +341,10 @@ class CollectiveController:
         self._trainer_hb = {}
         extra["PADDLE_HEARTBEAT_DIR"] = self.hb_dir
         extra["PADDLE_HEARTBEAT_INTERVAL"] = str(args.heartbeat_interval)
+        # drain contract: a serving rank (inference.serve) turns SIGTERM
+        # into drain mode and must finish in-flight requests within the
+        # SAME grace the gang teardown allows before SIGKILL
+        extra["PADDLE_STOP_GRACE"] = str(args.stop_grace)
         self.containers = []
         for lr in range(nproc):
             grank = node_erank * nproc + lr

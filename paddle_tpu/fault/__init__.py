@@ -52,6 +52,7 @@ from .injection import (  # noqa: F401
 )
 from .supervisor import (  # noqa: F401
     RESTART_EXIT_CODE,
+    EngineSupervisor,
     NonFiniteLossError,
     RestartRequested,
     Supervisor,

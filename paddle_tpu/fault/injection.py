@@ -183,6 +183,18 @@ def inject(name, context=None):
     raise InjectedFault(name, context)
 
 
+def should_fire(name, context=None):
+    """Non-raising fault point: True when `name` is armed with shots left
+    (consumes one shot and records the event).  For faults that cannot be
+    modeled as an exception at the point of injection — e.g. the serving
+    engine poisoning one slot's decode logits with NaN as traced data."""
+    if not _consume(name):
+        return False
+    logger.warning("fault point %r firing inline (context=%s)", name, context)
+    record_event("inject", f"{name} ({context})" if context else name)
+    return True
+
+
 def inject_hang(name, context=None, hang_sec=None):
     """Hang-flavored fault point: an armed `name` BLOCKS (sleeps
     FLAGS_fault_hang_sec) instead of raising, standing in for a peer-dead
@@ -207,3 +219,6 @@ register("collective.all_reduce", "fires at the entry of collective.all_reduce")
 register("collective.hang", "HANGS inside a collective Task.wait (watchdog drill)")
 register("launch.spawn", "fires when the launch controller spawns a trainer")
 register("supervisor.step", "fires inside Supervisor.after_step")
+register("serve.prefill.hang", "HANGS the serving engine's prefill dispatch (watchdog -> engine restart drill)")
+register("serve.decode.nan", "poisons ONE active slot's decode logits with NaN for one step (as traced data)")
+register("serve.loop.crash", "crashes the engine scheduler thread (EngineSupervisor restart drill)")

@@ -30,6 +30,7 @@ import logging
 import math
 import signal as _signal
 import threading
+import time
 
 from . import injection as _inj
 from . import heartbeat as _hb
@@ -291,6 +292,145 @@ class Supervisor:
             self._best_effort_save("crash")
             _hb.write_abort(f"crash: {type(e).__name__}: {e}")
             raise
+
+
+class EngineSupervisor:
+    """Watchdogged supervision for a serving engine (the serving mirror of
+    the launch controller's gang-restart loop, single-host).
+
+    The continuous-batching engine is one scheduler thread driving compiled
+    executables: a hung prefill (wedged device, injected
+    ``serve.prefill.hang``), a crashed loop (``serve.loop.crash``), or a
+    wedged step silently stalls every in-flight request.  This supervisor
+    polls three signals and performs a bounded restart-with-backoff of the
+    engine when any trips:
+
+    - **watchdog trip** — the engine arms its blocking regions (prefill
+      dispatch, decode dispatch, token fetch) with a per-engine
+      :class:`~paddle_tpu.fault.watchdog.Watchdog` whose action records the
+      overrun instead of killing the process (``FLAGS_serve_step_timeout_sec``);
+    - **dead scheduler thread** — the thread exited without ``stop()``
+      being called (an unhandled exception escaped the loop);
+    - **stalled progress** — the engine has work but its progress stamp
+      stopped advancing (belt-and-braces over the watchdog: catches a wedge
+      between armed regions).
+
+    ``engine.restart()`` is warm: same compiled executables, same KV pool
+    (0 fresh compiles — the test contract), in-flight requests resolved
+    exactly once (re-queued if no tokens were emitted, failed with the
+    typed ``EngineRestarted`` error otherwise).  Past ``max_restarts`` the
+    supervisor declares the engine dead and fails everything pending, so
+    clients get typed errors instead of hangs.
+    """
+
+    def __init__(self, engine, poll_interval=0.1, max_restarts=None,
+                 backoff=None, backoff_max=30.0, stall_timeout=None):
+        from ..framework import core as _core
+
+        self.engine = engine
+        self.poll_interval = float(poll_interval)
+        self.max_restarts = int(
+            max_restarts if max_restarts is not None
+            else _core.flag("FLAGS_serve_max_restarts")
+        )
+        self.backoff = float(
+            backoff if backoff is not None
+            else _core.flag("FLAGS_serve_restart_backoff")
+        )
+        self.backoff_max = float(backoff_max)
+        # stall detection defaults to the watchdog deadline (0 disables):
+        # the watchdog covers armed regions, this covers the gaps between
+        self.stall_timeout = stall_timeout
+        self.restarts = 0
+        self.dead = False
+        self._stop = threading.Event()
+        self._thread = None
+
+    def _stall_deadline(self):
+        if self.stall_timeout is not None:
+            return float(self.stall_timeout)
+        from ..framework import core as _core
+
+        t = float(_core.flag("FLAGS_serve_step_timeout_sec"))
+        return 4 * t if t > 0 else 0.0
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="engine-supervisor", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- detection ---------------------------------------------------------
+    def check(self):
+        """One health probe: a reason string when the engine needs a
+        restart, else None."""
+        eng = self.engine
+        trip = eng._watchdog_trip
+        if trip is not None:
+            region, elapsed = trip
+            return f"watchdog: region {region!r} exceeded {elapsed:.1f}s"
+        t = eng._thread
+        if t is not None and not t.is_alive() and not eng._stop:
+            return "scheduler thread died"
+        stall = self._stall_deadline()
+        if (
+            stall > 0
+            and t is not None
+            and eng.has_work()
+            and time.monotonic() - eng._last_progress > stall
+        ):
+            return f"no scheduler progress for {stall:.1f}s with work pending"
+        return None
+
+    # -- recovery ----------------------------------------------------------
+    def _run(self):
+        while not self._stop.is_set() and not self.dead:
+            reason = self.check()
+            if reason is not None:
+                self.restart(reason)
+            self._stop.wait(self.poll_interval)
+
+    def restart(self, reason):
+        """Bounded restart-with-backoff; past the budget, declare the
+        engine dead and fail everything pending with typed errors."""
+        if self.restarts >= self.max_restarts:
+            logger.error(
+                "engine supervisor: restart budget (%d) exhausted (%s); "
+                "declaring the engine dead", self.max_restarts, reason,
+            )
+            _inj.record_event(
+                "engine", f"restart budget exhausted after {self.restarts} ({reason})"
+            )
+            self.dead = True
+            self.engine.fail_all(f"restart budget exhausted ({reason})")
+            return False
+        delay = min(self.backoff * (2 ** self.restarts), self.backoff_max)
+        self.restarts += 1
+        logger.error(
+            "engine supervisor: %s; engine restart %d/%d in %.2fs",
+            reason, self.restarts, self.max_restarts, delay,
+        )
+        if delay > 0:
+            time.sleep(delay)
+        self.engine.restart(reason)
+        return True
 
 
 def run_supervised(step_fn, steps, save_fn=None, max_bad_steps=3, start_step=0):

@@ -437,6 +437,34 @@ define_flag(
     "continuous-batching engine: comma-separated prompt-length buckets; each "
     "bucket compiles one prefill executable (prompts pad up to the bucket)",
 )
+define_flag(
+    "FLAGS_serve_step_timeout_sec", 0.0,
+    "serving watchdog deadline (s) for the engine's armed regions (prefill "
+    "dispatch, decode dispatch, token fetch); a region overrunning it trips "
+    "the EngineSupervisor into a bounded warm engine restart.  0 disables.",
+)
+define_flag(
+    "FLAGS_serve_max_restarts", 3,
+    "EngineSupervisor restart budget: after this many engine restarts the "
+    "supervisor declares the engine dead and fails all pending requests",
+)
+define_flag(
+    "FLAGS_serve_restart_backoff", 0.5,
+    "initial delay (s) before an engine restart, doubled per consecutive "
+    "restart (the serving mirror of launch --restart_backoff)",
+)
+define_flag(
+    "FLAGS_serve_drain_grace", 10.0,
+    "SIGTERM drain budget (s) for serve(): stop admitting, finish in-flight "
+    "up to this long, then exit cleanly.  Overridden by PADDLE_STOP_GRACE "
+    "when launched under distributed.launch (--stop_grace).",
+)
+define_flag(
+    "FLAGS_serve_debug_invariants", False,
+    "after every scheduler step assert slot-pool invariants (no slot both "
+    "free and active, one live request per slot, positions <= max_len) — "
+    "turns silent slot leaks into loud failures in tests/CI",
+)
 
 
 # ---------------------------------------------------------------------------

@@ -158,7 +158,8 @@ class GenerationPredictor:
         return self
 
 
-def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True):
+def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
+          supervise=True, handle_signals=None):
     """Serving loop (reference capability: the AnalysisPredictor behind
     paddle_serving — SURVEY.md §2.1 "Inference runtime").  Stdlib-only
     ThreadingHTTPServer with a bounded admission gate: requests beyond the
@@ -166,20 +167,38 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True):
     up behind the executable.
 
     - GET  /health            -> 200
+    - GET  /healthz           -> lifecycle snapshot: status live/ready/
+      draining/dead + occupancy, queue depth, restart count, drain estimate
     - POST /predict           -> {"outputs": [...]}   (Predictor)
     - POST /generate          -> {"tokens": [...]}    (GenerationPredictor or
       ContinuousBatchingEngine; body: {"input_ids": [...] or [[...], ...],
-      "max_new_tokens": n, "temperature": t, "eos_token_id": id})
+      "max_new_tokens": n, "temperature": t, "eos_token_id": id,
+      "deadline_s": s})
 
     A ContinuousBatchingEngine serves /generate with true continuous
     batching: concurrent requests decode interleaved in the slot pool, each
     finishing on its own EOS/length (the lock-based predictors serialize).
+
+    Serving fault domain (PR 6): an engine-backed server runs under a
+    ``fault.EngineSupervisor`` (``supervise=False`` opts out) — a wedged or
+    dead scheduler gets a bounded warm restart, and past the budget clients
+    get typed 503s instead of hangs.  Every 503 carries a ``Retry-After``
+    header derived from the engine's queue-drain estimate.  SIGTERM (when
+    serve() runs on the main thread, or ``handle_signals=True``) triggers
+    DRAIN: stop admitting, finish in-flight work up to ``PADDLE_STOP_GRACE``
+    seconds (exported by ``distributed.launch --stop_grace``; else
+    ``FLAGS_serve_drain_grace``), then stop cleanly.  ``server.drain(grace)``
+    does the same programmatically.
     """
     import json
+    import signal as _signal
     import threading
+    import time as _time
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-    from .engine import ContinuousBatchingEngine, QueueFull
+    from . import engine as engine_mod
+    from .engine import ContinuousBatchingEngine, EngineUnavailable
+    from ..fault import EngineSupervisor
     from ..framework import core as _fcore
 
     predictor = (
@@ -191,32 +210,58 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True):
         else Predictor(path_or_predictor)
     )
     engine = predictor if isinstance(predictor, ContinuousBatchingEngine) else None
+    supervisor = None
     if engine is not None:
         engine.start()
+        if supervise:
+            supervisor = EngineSupervisor(engine).start()
     lock = threading.Lock()
     # admission bound for the lock-based predictor paths: at most
     # queue_depth requests running-or-waiting; the rest shed with 503
     # (the engine has its own bounded queue — submit raises QueueFull)
     gate = threading.BoundedSemaphore(int(_fcore.flag("FLAGS_serve_queue_depth")))
+    state = {"draining": False}
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
             pass
 
-        def _reply(self, code, payload):
+        def _reply(self, code, payload, headers=None):
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
-        def _busy(self):
-            self._reply(503, {"error": "admission queue full, retry later"})
+        def _busy(self, msg="admission queue full, retry later", retry_after=None):
+            # Retry-After from the queue-drain estimate: a shed client
+            # retries when a slot is plausibly free, not immediately
+            if retry_after is None and engine is not None:
+                retry_after = engine.estimate_drain_s()
+            headers = {}
+            if retry_after:
+                headers["Retry-After"] = str(max(1, int(retry_after + 0.5)))
+            self._reply(503, {"error": msg, "retry_after_s": retry_after or 0},
+                        headers)
+
+        def _healthz(self):
+            if engine is not None:
+                h = engine.healthz()
+                if state["draining"] and h["status"] not in ("dead",):
+                    h["status"] = "draining"
+            else:
+                h = {"status": "draining" if state["draining"] else "ready"}
+            code = 200 if h["status"] in ("ready", "live") else 503
+            self._reply(code, h)
 
         def do_GET(self):
             if self.path == "/health":
                 self._reply(200, {"status": "ok"})
+            elif self.path == "/healthz":
+                self._healthz()
             else:
                 self._reply(404, {"error": "use POST /predict"})
 
@@ -235,22 +280,33 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True):
                                 max_new_tokens=int(req.get("max_new_tokens") or 32),
                                 temperature=float(req.get("temperature", 0.0)),
                                 eos_token_id=req.get("eos_token_id"),
+                                deadline_s=req.get("deadline_s"),
                             )
                         )
-                except QueueFull:
-                    # rows already admitted still complete server-side;
-                    # the client sheds and retries the whole batch
-                    self._busy()
+                except EngineUnavailable as e:
+                    # queue full / draining / unattainable deadline / dead:
+                    # rows already admitted still complete server-side; the
+                    # client sheds and retries the whole batch
+                    self._busy(str(e), retry_after=e.retry_after_s)
                     return
                 outs = [h.wait(timeout=600).tolist() for h in handles]
                 self._reply(
                     200,
                     {"tokens": outs if isinstance(ids[0], list) else outs[0]},
                 )
+            except engine_mod.EngineRestarted as e:
+                # in-flight state was lost to a warm restart: typed 503,
+                # the request is safe to retry
+                self._busy(f"{type(e).__name__}: {e}")
+            except engine_mod.DeadlineExceeded as e:
+                self._reply(504, {"error": f"{type(e).__name__}: {e}"})
             except Exception as e:
                 self._reply(400, {"error": f"{type(e).__name__}: {e}"})
 
         def do_POST(self):
+            if state["draining"]:
+                self._busy("server draining, retry elsewhere")
+                return
             if self.path == "/generate" and engine is not None:
                 self._generate_engine()
                 return
@@ -298,8 +354,72 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True):
                 gate.release()
 
     server = ThreadingHTTPServer((host, port), Handler)
+
+    # -- graceful drain (SIGTERM / programmatic) ----------------------------
+    prev_handler = {}
+
+    def _restore_handler():
+        if _signal.SIGTERM in prev_handler:
+            try:
+                _signal.signal(_signal.SIGTERM, prev_handler.pop(_signal.SIGTERM))
+            except (ValueError, KeyError):
+                pass
+
+    def drain(grace=None):
+        """Stop admitting (503 + Retry-After), let in-flight work finish up
+        to `grace` seconds (PADDLE_STOP_GRACE env — exported by
+        distributed.launch --stop_grace — else FLAGS_serve_drain_grace),
+        then stop supervisor, engine, and HTTP loop.  Idempotent; returns
+        the worker thread so callers can join it."""
+        if state["draining"]:
+            return state.get("drain_thread")
+        state["draining"] = True
+        if grace is None:
+            grace = float(
+                os.environ.get(
+                    "PADDLE_STOP_GRACE", _fcore.flag("FLAGS_serve_drain_grace")
+                )
+            )
+
+        def _worker():
+            if engine is not None:
+                engine.drain()
+                deadline = _time.monotonic() + float(grace)
+                while engine.has_work() and _time.monotonic() < deadline:
+                    _time.sleep(0.02)
+            if supervisor is not None:
+                supervisor.stop()
+            if engine is not None:
+                engine.stop()
+            server.shutdown()
+            _restore_handler()
+
+        t = threading.Thread(target=_worker, name="serve-drain", daemon=True)
+        state["drain_thread"] = t
+        t.start()
+        return t
+
+    server.drain = drain
+    server.supervisor = supervisor
+    server.engine = engine
+
+    # SIGTERM → drain: installable only from the main thread; default to
+    # trying when the caller did not say (tests spawn serve() off-thread and
+    # silently skip, launched serving ranks run on main and get it)
+    if handle_signals or handle_signals is None:
+        try:
+            prev_handler[_signal.SIGTERM] = _signal.signal(
+                _signal.SIGTERM, lambda signum, frame: drain()
+            )
+        except ValueError:
+            if handle_signals:
+                raise
+
     if block:
-        server.serve_forever()
+        try:
+            server.serve_forever()
+        finally:
+            _restore_handler()
         return server
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()
@@ -309,7 +429,11 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True):
 def __getattr__(name):
     # engine symbols load lazily: paddle_tpu/__init__ imports this module
     # during package init, before the model stack the engine depends on
-    if name in ("ContinuousBatchingEngine", "EngineRequest", "QueueFull"):
+    if name in (
+        "ContinuousBatchingEngine", "EngineRequest", "QueueFull",
+        "EngineUnavailable", "DeadlineUnattainable", "DeadlineExceeded",
+        "RequestCancelled", "EngineRestarted", "NonFiniteLogits",
+    ):
         from . import engine as _engine
 
         return getattr(_engine, name)

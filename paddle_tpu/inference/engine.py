@@ -28,50 +28,174 @@ Compiled-executable budget: len(prefill_buckets) + 1 (asserted by tests via
 `compile_counts()`).  Both functions ride @to_static, so PR 3's persistent
 compile cache and AOT snapshots apply per bucket: a restarted server binds
 the previous process's executables without tracing.
+
+Serving fault domain (the serving mirror of the training fault domain):
+
+- **Request lifecycle** — every submitted request resolves EXACTLY once:
+  queued → prefilling → decoding → {eos, length, timeout, cancelled,
+  restarted, error}.  `deadline_s` evicts an expired slot at step
+  granularity (slot recycle, no recompile) and `submit` rejects requests
+  whose deadline cannot beat the current queue-drain estimate; `cancel()`
+  frees the slot the same way.
+- **Watchdogged regions** — prefill dispatch, decode dispatch, and the
+  host token fetch run under `fault.watchdog.arm` with deadline
+  `FLAGS_serve_step_timeout_sec`; an overrun records a trip (it does NOT
+  kill the process — serving restarts the ENGINE) that the
+  `fault.EngineSupervisor` turns into a bounded warm restart.
+- **Warm restart** — `restart()` abandons a wedged scheduler thread via a
+  generation counter (the stale thread aborts at its next state touch),
+  re-queues in-flight requests that emitted no tokens yet, fails the rest
+  with the typed `EngineRestarted` error, and rebinds the SAME compiled
+  executables and KV pool: 0 fresh compiles, asserted by the chaos drills.
+  Reusing the pool un-scrubbed is safe by the padding-garbage invariant
+  above.
+- **Injectable faults** — `serve.prefill.hang` (blocks the prefill
+  dispatch), `serve.decode.nan` (poisons ONE slot's logits with NaN as
+  traced data for one step; only that request errors, co-batched requests
+  are bit-identical to an unpoisoned run), `serve.loop.crash` (kills the
+  scheduler thread) — armed via the usual `FLAGS_fault_inject` registry.
 """
 
 from __future__ import annotations
 
+import itertools
+import logging
+import math
 import queue
 import threading
 import time
 
 import numpy as np
 
+from ..fault import injection as _inj
+from ..fault import watchdog as _wd
 from ..framework import core as _fcore
 from ..models.llama import SlotView, StaticKVCache
 from ..tensor import Tensor
 
+logger = logging.getLogger("paddle_tpu")
 
-class QueueFull(RuntimeError):
+
+class EngineUnavailable(RuntimeError):
+    """The engine cannot take this request right now (queue full, draining,
+    dead, or an unattainable deadline) — serve() maps this family to HTTP
+    503 with a Retry-After derived from the queue-drain estimate."""
+
+    def __init__(self, msg, retry_after_s=None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class QueueFull(EngineUnavailable):
     """Admission queue at capacity — submit() fails fast (serve() maps this
     to HTTP 503)."""
 
 
+class DeadlineUnattainable(EngineUnavailable):
+    """Deadline-aware admission: the request's deadline cannot beat the
+    current queue-drain estimate, so admitting it would only burn a slot on
+    work guaranteed to be evicted."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's deadline expired mid-flight; its slot was evicted at
+    step granularity (recycled, no recompile)."""
+
+    def __init__(self, request_id, tokens_done, max_new_tokens, deadline_s):
+        self.request_id = request_id
+        self.tokens_done = tokens_done
+        super().__init__(
+            f"request {request_id} missed its {deadline_s}s deadline "
+            f"({tokens_done}/{max_new_tokens} tokens generated)"
+        )
+
+
+class RequestCancelled(RuntimeError):
+    """The request was cancelled via EngineRequest.cancel()."""
+
+    def __init__(self, request_id, tokens_done):
+        self.request_id = request_id
+        self.tokens_done = tokens_done
+        super().__init__(
+            f"request {request_id} cancelled ({tokens_done} tokens generated)"
+        )
+
+
+class EngineRestarted(RuntimeError):
+    """503-style typed error: the engine restarted (or died) while this
+    request was in flight and its decode state was lost.  The request was
+    NOT silently dropped — retry it."""
+
+    def __init__(self, request_id, reason=""):
+        self.request_id = request_id
+        self.reason = reason
+        msg = f"engine restarted while request {request_id} was in flight"
+        if reason:
+            msg += f" ({reason})"
+        super().__init__(msg + "; retry the request")
+
+
+class NonFiniteLogits(FloatingPointError):
+    """This request's decode produced a non-finite logit window; it errors
+    alone — co-batched slots are row-independent and finish unaffected."""
+
+
+class _StaleEngine(Exception):
+    """Internal: the scheduler generation this thread was started for was
+    superseded by a restart; abort without touching engine state."""
+
+
 class EngineRequest:
     """Handle for one submitted generation: streaming callback target,
-    completion event, and timing for the serving gauges."""
+    completion event, deadline/cancellation, and timing for the serving
+    gauges.  Lifecycle: queued → prefilling → decoding → one of
+    {eos, length, timeout, cancelled, restarted, error} — exactly once."""
 
-    def __init__(self, prompt, max_new_tokens, temperature, eos_token_id, on_token):
+    def __init__(self, rid, prompt, max_new_tokens, temperature, eos_token_id,
+                 on_token, deadline_s=None):
+        self.id = int(rid)
         self.prompt = prompt  # np.int32 [L]
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.eos_token_id = eos_token_id
         self.on_token = on_token
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
         self.tokens = []  # generated ids (includes eos when hit)
         self.finished = threading.Event()
-        self.finish_reason = None  # "eos" | "length" | "error"
+        self.finish_reason = None  # eos|length|timeout|cancelled|restarted|error
+        self.state = "queued"  # live phase; finish_reason once terminal
+        self.cancelled = False
         self.error = None
         self.ttft_s = None
         self._submit_t = None
+        self._deadline_t = None  # absolute perf_counter deadline
         self._finish_t = None
 
+    def cancel(self):
+        """Ask the scheduler to evict this request at its next step: a
+        queued request resolves without ever taking a slot, a slotted one
+        has its slot recycled (no recompile).  Idempotent; resolution is
+        still exactly-once (`finish_reason == "cancelled"`)."""
+        self.cancelled = True
+        return self
+
+    def expired(self, now=None):
+        if self._deadline_t is None:
+            return False
+        return (time.perf_counter() if now is None else now) >= self._deadline_t
+
     def wait(self, timeout=None):
-        """Block until the request finishes; returns prompt + generated ids."""
+        """Block until the request finishes; returns prompt + generated ids.
+        Raises a TimeoutError naming the request and its live state when
+        `timeout` elapses first (never a None-ish partial result), and
+        re-raises the request's typed error (DeadlineExceeded,
+        RequestCancelled, EngineRestarted, NonFiniteLogits, ...) when the
+        request resolved unsuccessfully."""
         if not self.finished.wait(timeout):
             raise TimeoutError(
-                f"generation not finished after {timeout}s "
-                f"({len(self.tokens)}/{self.max_new_tokens} tokens)"
+                f"request {self.id} not finished after {timeout}s "
+                f"(state={self.state}, "
+                f"{len(self.tokens)}/{self.max_new_tokens} tokens)"
             )
         if self.error is not None:
             raise self.error
@@ -83,12 +207,14 @@ class ContinuousBatchingEngine:
     compiled static-KV decode contract (`model.llama(toks, caches=, pos=)` +
     `model.lm_head`, i.e. LlamaForCausalLM and shape-compatible models).
 
-    submit() enqueues (bounded admission queue -> QueueFull); the scheduler —
-    either the background thread started by start()/serve(), or synchronous
+    submit() enqueues (bounded admission queue -> QueueFull, deadline-aware
+    admission -> DeadlineUnattainable); the scheduler — either the
+    background thread started by start()/serve(), or synchronous
     step()/run_until_idle() calls — admits queued requests into free slots
     via bucketed prefill and advances all active slots one token per decode
-    step.  Tokens stream through per-request `on_token` callbacks as they are
-    produced.
+    step.  Tokens stream through per-request `on_token` callbacks as they
+    are produced.  Pair with `fault.EngineSupervisor` for watchdogged
+    restart-with-backoff of a wedged/dead scheduler.
     """
 
     def __init__(self, model, slots=None, max_len=None, prefill_buckets=None,
@@ -131,7 +257,8 @@ class ContinuousBatchingEngine:
         self._prefill_fn = jit.to_static(self._prefill_body)
         self._key = to_tensor(np.asarray(jax.random.PRNGKey(int(seed))))
 
-        # host-side slot table — touched only by the scheduling thread
+        # host-side slot table — mutated only under _mu, by the scheduler
+        # generation that owns the engine (restart supersedes via _gen)
         self._slot_req = [None] * self.slots
         self._pos = np.zeros(self.slots, np.int32)
         self._last_tok = np.zeros(self.slots, np.int32)
@@ -139,24 +266,46 @@ class ContinuousBatchingEngine:
         # device-resident decode loop state (toks, pos, active, temps),
         # rebuilt from the host mirrors only when slot membership changes
         self._dev = None
-        # decode steps dispatched but not yet fetched to host: [(nxt, idx)]
+        # decode steps dispatched but not yet fetched to host:
+        # [(nxt, finite, active_idx, dispatch_t)]
         self._pending_fetch = []
+        # all-False poison vector reused every un-poisoned step (no per-step
+        # H2D); serve.decode.nan swaps in a one-hot row for one step
+        self._poison_zero = to_tensor(np.zeros(self.slots, bool))
 
         self._queue = queue.Queue(maxsize=self.queue_depth)
+        self._requeue = []  # restart-recovered requests, ahead of the queue
+        self._queued_new_tokens = 0  # tokens owed to queued+requeued work
+        self._admitting = None  # request between queue-pop and slot landing
         self._cv = threading.Condition()
+        self._mu = threading.RLock()  # slot table / device state / requeue
         self._thread = None
         self._stop = False
 
+        # fault domain: generation counter fences restarted-away schedulers;
+        # the per-engine watchdog records trips instead of exiting
+        self._gen = 0
+        self._dead = False
+        self._draining = False
+        self.restart_count = 0
+        self._watchdog = _wd.Watchdog(action=self._on_watchdog)
+        self._watchdog_trip = None  # (region, deadline_s) set by the monitor
+        self._last_progress = time.monotonic()
+        self._step_ewma_s = None  # EWMA wall seconds per decode round
+
     # -- compiled bodies ----------------------------------------------------
 
-    def _decode_body(self, toks, pos, active, temps, key):
+    def _decode_body(self, toks, pos, active, temps, poison, key):
         """One token for every slot: toks [S,1], pos [S], active [S] bool,
-        temps [S] f32 (0 = greedy, >0 = sampled — per-slot, as data), key
+        temps [S] f32 (0 = greedy, >0 = sampled — per-slot, as data), poison
+        [S] bool (chaos-only NaN injection — identity when all-False), key
         uint32[2].  Inactive slots run at pos 0 (scratch, see module doc).
-        Returns (next tokens [S,1], advanced pos [S], key): the loop state is
-        device-resident and threads straight back in — between membership
-        changes a decode step costs one executable dispatch plus the [S]
-        token fetch, zero host->device transfers."""
+        Returns (next tokens [S,1], advanced pos [S], finite [S], key): the
+        loop state is device-resident and threads straight back in — between
+        membership changes a decode step costs one executable dispatch plus
+        the [S] token fetch, zero host->device transfers.  `finite` is the
+        per-slot non-finite-logit-window watch: a poisoned/diverged slot
+        errors alone, its co-batched rows are independent."""
         import jax
         import jax.numpy as jnp
 
@@ -168,20 +317,23 @@ class ContinuousBatchingEngine:
         hidden, _ = self.model.llama(toks, caches=self._caches, pos=pos_eff)
         logits = self.model.lm_head(hidden)[:, -1]  # [S, V]
 
-        def f(lg, ky, tp, p, a):
+        def f(lg, ky, tp, p, a, po):
             lgf = lg.astype(jnp.float32)
+            lgf = jnp.where(po[:, None], jnp.nan, lgf)
+            finite = jnp.all(jnp.isfinite(lgf), axis=-1) | ~a
             greedy = jnp.argmax(lgf, axis=-1).astype(jnp.int32)
             ky, sub = jax.random.split(ky)
             samp = jax.random.categorical(
                 sub, lgf / jnp.maximum(tp, 1e-6)[:, None], axis=-1
             ).astype(jnp.int32)
             nxt = jnp.where(tp > 0.0, samp, greedy)
-            return nxt[:, None], jnp.where(a, p + 1, p), ky
+            return nxt[:, None], jnp.where(a, p + 1, p), finite, ky
 
-        nxt, new_pos, key = apply(
-            f, [logits, key, temps, pos, active], multi=True, name="serve_sample"
+        nxt, new_pos, finite, key = apply(
+            f, [logits, key, temps, pos, active, poison], multi=True,
+            name="serve_sample",
         )
-        return nxt, new_pos, key
+        return nxt, new_pos, finite, key
 
     def _prefill_body(self, toks, slot, true_len, temp, key):
         """Bucketed prefill: toks [1, bucket] (right-padded), slot / true_len
@@ -216,10 +368,15 @@ class ContinuousBatchingEngine:
     # -- public API ---------------------------------------------------------
 
     def submit(self, input_ids, max_new_tokens=32, temperature=0.0,
-               eos_token_id=None, on_token=None):
+               eos_token_id=None, on_token=None, deadline_s=None):
         """Enqueue one request (1-D token ids).  Returns an EngineRequest
         handle immediately; raises QueueFull when the admission queue is at
-        capacity."""
+        capacity, DeadlineUnattainable when `deadline_s` cannot beat the
+        current queue-drain estimate (deadline-aware admission), and
+        EngineUnavailable while draining or after the restart budget is
+        spent."""
+        from .. import profiler as _prof
+
         ids = np.asarray(input_ids, np.int32).reshape(-1)
         if ids.size == 0:
             raise ValueError("empty prompt")
@@ -229,17 +386,47 @@ class ContinuousBatchingEngine:
             )
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        req = EngineRequest(ids, max_new_tokens, temperature, eos_token_id, on_token)
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError("deadline_s must be > 0")
+        if self._dead:
+            raise EngineUnavailable(
+                "engine is dead (restart budget exhausted); restart the server"
+            )
+        if self._draining:
+            raise EngineUnavailable(
+                "engine is draining (shutdown in progress)",
+                retry_after_s=self.estimate_drain_s(),
+            )
+        if deadline_s is not None:
+            est = self.estimate_drain_s()
+            if est > float(deadline_s):
+                _prof.record_serving_fault("rejected_deadline")
+                raise DeadlineUnattainable(
+                    f"deadline {deadline_s}s cannot beat the current "
+                    f"queue-drain estimate {est:.2f}s",
+                    retry_after_s=est,
+                )
+        req = EngineRequest(
+            next(self._req_ids), ids, max_new_tokens, temperature,
+            eos_token_id, on_token, deadline_s=deadline_s,
+        )
         req._submit_t = time.perf_counter()
+        if deadline_s is not None:
+            req._deadline_t = req._submit_t + float(deadline_s)
         try:
             self._queue.put_nowait(req)
         except queue.Full:
             raise QueueFull(
-                f"admission queue full ({self.queue_depth} pending)"
+                f"admission queue full ({self.queue_depth} pending)",
+                retry_after_s=self.estimate_drain_s(),
             ) from None
+        with self._mu:
+            self._queued_new_tokens += req.max_new_tokens
         with self._cv:
             self._cv.notify()
         return req
+
+    _req_ids = itertools.count(1)  # request ids unique across engines
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
                  eos_token_id=None, timeout=None):
@@ -265,18 +452,20 @@ class ContinuousBatchingEngine:
                 to_tensor(np.int32(0)), to_tensor(np.int32(b)),
                 to_tensor(np.float32(0.0)), self._key,
             )
-        _, _, self._key = self._decode_fn(
+        _, _, _, self._key = self._decode_fn(
             to_tensor(np.zeros((self.slots, 1), np.int32)),
             to_tensor(np.zeros(self.slots, np.int32)),
             to_tensor(np.zeros(self.slots, bool)),
             to_tensor(np.zeros(self.slots, np.float32)),
+            self._poison_zero,
             self._key,
         )
         return self
 
     def compile_counts(self):
         """{prefill, decode} trace counts + AOT snapshot hits — the test
-        contract is prefill == len(buckets used) and decode == 1, forever."""
+        contract is prefill == len(buckets used) and decode == 1, forever
+        (engine restarts included: restart rebinds the same executables)."""
         return {
             "prefill": self._prefill_fn.trace_count,
             "decode": self._decode_fn.trace_count,
@@ -289,22 +478,79 @@ class ContinuousBatchingEngine:
 
     @property
     def pending(self):
-        return self._queue.qsize()
+        return self._queue.qsize() + len(self._requeue)
+
+    def has_work(self):
+        """True when anything is queued, being admitted, or decoding."""
+        return bool(
+            self._queue.qsize() or self._requeue or self._admitting is not None
+            or self.active_slots
+        )
+
+    def estimate_drain_s(self):
+        """Rough wall seconds until the current backlog drains: tokens still
+        owed to active slots plus tokens requested by queued work, decoded
+        `slots` at a time at the EWMA decode-round wall time.  0 before any
+        traffic (no evidence, admit everything) — feeds deadline-aware
+        admission and the Retry-After header on 503s."""
+        ew = self._step_ewma_s
+        if not ew:
+            return 0.0
+        with self._mu:
+            active = sum(
+                max(0, r.max_new_tokens - len(r.tokens))
+                for r in self._slot_req if r is not None
+            )
+            queued = max(0, self._queued_new_tokens)
+        if not (active or queued):
+            return 0.0
+        return math.ceil((active + queued) / max(1, self.slots)) * ew
+
+    def healthz(self):
+        """Liveness/readiness snapshot for serve()'s /healthz: live (engine
+        exists, scheduler not running), ready (scheduler thread alive),
+        draining, or dead (restart budget exhausted) — plus occupancy,
+        queue depth, restart count, and the queue-drain estimate."""
+        t = self._thread
+        if self._dead:
+            status = "dead"
+        elif self._draining:
+            status = "draining"
+        elif t is not None and t.is_alive():
+            status = "ready"
+        else:
+            status = "live"
+        return {
+            "status": status,
+            "slots": self.slots,
+            "active_slots": self.active_slots,
+            "occupancy": self.active_slots / self.slots,
+            "queue_depth": self.pending,
+            "restarts": self.restart_count,
+            "drain_estimate_s": round(self.estimate_drain_s(), 3),
+        }
 
     # -- scheduler ----------------------------------------------------------
 
-    def step(self):
-        """One scheduling tick: admit queued requests into free slots
-        (bucketed prefill), then advance every active slot one token.
-        Returns the number of tokens emitted (prefill first-tokens included).
-        Synchronous alternative to start() — never mix the two."""
-        emitted = self._admit()
-        return emitted + self._decode_once()
+    def step(self, gen=None):
+        """One scheduling tick: evict expired/cancelled slots, admit queued
+        requests into free slots (bucketed prefill), then advance every
+        active slot one token.  Returns the number of tokens emitted
+        (prefill first-tokens included).  Synchronous alternative to
+        start() — never mix the two."""
+        gen = self._gen if gen is None else gen
+        self._evict_expired(gen)
+        emitted = self._admit(gen)
+        n = emitted + self._decode_once(gen)
+        if _fcore.flag("FLAGS_serve_debug_invariants"):
+            self._check_invariants()
+        self._last_progress = time.monotonic()
+        return n
 
     def run_until_idle(self):
         """Drive step() until queue and slots are empty (synchronous mode)."""
         total = 0
-        while self._queue.qsize() or self.active_slots:
+        while not self._dead and self.has_work():
             total += self.step()
         return total
 
@@ -319,30 +565,211 @@ class ContinuousBatchingEngine:
         self._thread.start()
         return self
 
-    def stop(self):
-        if self._thread is None:
-            return
-        self._stop = True
-        with self._cv:
-            self._cv.notify_all()
-        self._thread.join(timeout=30)
-        self._thread = None
+    def stop(self, timeout=30.0):
+        """Stop the scheduler (bounded join) and flush pending host token
+        fetches, so a stop racing an in-flight decode cannot leave
+        dispatched tokens unemitted or `on_token` callbacks unfired."""
+        t = self._thread
+        if t is not None:
+            self._stop = True
+            with self._cv:
+                self._cv.notify_all()
+            t.join(timeout)
+            if t.is_alive():
+                # wedged mid-dispatch: abandon it behind the generation fence
+                logger.error(
+                    "engine scheduler did not stop within %.1fs; abandoning "
+                    "the thread", timeout,
+                )
+                with self._mu:
+                    self._gen += 1
+            self._thread = None
+        with self._mu:
+            try:
+                self._flush_pending_locked()
+            except _StaleEngine:
+                pass
+            except Exception:
+                logger.exception("engine stop: pending-token flush failed")
+
+    def drain(self):
+        """Stop admitting (submit raises EngineUnavailable / serve() sheds
+        with 503 + Retry-After); in-flight work keeps decoding."""
+        self._draining = True
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def __del__(self):
+        try:
+            t = self._thread
+            if t is not None:
+                self._stop = True
+                with self._cv:
+                    self._cv.notify_all()
+                t.join(timeout=1.0)
+        except Exception:
+            pass
+
+    # -- fault domain: restart / fail-all ------------------------------------
+
+    def _on_watchdog(self, region, elapsed):
+        # recorded, not fatal: serving restarts the ENGINE, not the process;
+        # the EngineSupervisor polls this trip into a bounded warm restart
+        self._watchdog_trip = (region, elapsed)
+
+    def _wd_timeout(self):
+        return float(_fcore.flag("FLAGS_serve_step_timeout_sec"))
+
+    def _check_gen(self, gen):
+        if gen != self._gen:
+            raise _StaleEngine(
+                f"scheduler generation {gen} superseded by {self._gen}"
+            )
+
+    def restart(self, reason=""):
+        """Bounded warm restart (EngineSupervisor calls this): abandon the
+        possibly-wedged scheduler thread behind the generation fence,
+        resolve every in-flight request exactly once — re-queued for
+        re-prefill when it emitted no tokens yet, failed with the typed
+        EngineRestarted error when its stream already started — and start a
+        fresh scheduler bound to the SAME compiled executables and KV pool
+        (0 fresh compiles; the pool needs no scrub, garbage rows are never
+        attended)."""
+        from .. import profiler as _prof
+
+        # a thread wedged inside the armed fetch region may hold _mu; after
+        # a bounded wait we proceed anyway — the generation fence makes the
+        # stale thread drop its results instead of corrupting the new life
+        locked = self._mu.acquire(timeout=1.0)
+        try:
+            self._gen += 1
+            old, self._thread = self._thread, None
+            was_threaded = old is not None
+            requeue, fail = [], []
+            adm, self._admitting = self._admitting, None
+            if adm is not None and not adm.finished.is_set():
+                (requeue if not adm.tokens else fail).append(adm)
+            for s in range(self.slots):
+                req = self._slot_req[s]
+                self._slot_req[s] = None
+                if req is None or req.finished.is_set():
+                    continue
+                (requeue if not req.tokens else fail).append(req)
+            self._pos[:] = 0
+            self._last_tok[:] = 0
+            self._temps[:] = 0.0
+            self._dev = None
+            self._pending_fetch = []
+            self._watchdog_trip = None
+            self._last_progress = time.monotonic()
+            for req in requeue:
+                req.state = "queued"
+                self._queued_new_tokens += req.max_new_tokens
+            self._requeue = requeue + self._requeue
+            self.restart_count += 1
+        finally:
+            if locked:
+                self._mu.release()
+        for req in fail:
+            req.error = EngineRestarted(req.id, reason)
+            self._resolve(req, "restarted")
+        _inj.record_event("engine", f"restart #{self.restart_count}: {reason}")
+        _prof.record_serving_fault("restarts")
+        logger.warning(
+            "engine restart #%d (%s): %d request(s) re-queued, %d failed "
+            "with EngineRestarted", self.restart_count, reason or "?",
+            len(requeue), len(fail),
+        )
+        self._stop = False
+        if was_threaded:
+            self.start()
+        return self
+
+    def fail_all(self, reason=""):
+        """Terminal: mark the engine dead (submit raises EngineUnavailable)
+        and resolve EVERY pending request — queued, admitting, slotted —
+        with the typed EngineRestarted error, exactly once.  Called by the
+        EngineSupervisor when the restart budget is spent: clients get
+        errors, never hangs."""
+        self._dead = True
+        pending = []
+        locked = self._mu.acquire(timeout=1.0)
+        try:
+            self._gen += 1  # fence out any wedged scheduler
+            self._thread = None
+            adm, self._admitting = self._admitting, None
+            if adm is not None:
+                pending.append(adm)
+            for s in range(self.slots):
+                if self._slot_req[s] is not None:
+                    pending.append(self._slot_req[s])
+                    self._slot_req[s] = None
+            pending.extend(self._requeue)
+            self._requeue = []
+            while True:
+                try:
+                    pending.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            self._queued_new_tokens = 0
+            self._pos[:] = 0
+            self._last_tok[:] = 0
+            self._temps[:] = 0.0
+            self._dev = None
+            self._pending_fetch = []
+        finally:
+            if locked:
+                self._mu.release()
+        for req in pending:
+            if not req.finished.is_set():
+                req.error = EngineRestarted(req.id, reason or "engine dead")
+                self._resolve(req, "restarted")
+        _inj.record_event("engine", f"fail_all: {reason} ({len(pending)} requests)")
+        logger.error(
+            "engine dead (%s): %d pending request(s) failed with "
+            "EngineRestarted", reason or "?", len(pending),
+        )
+        return len(pending)
 
     def _loop(self):
-        while not self._stop:
-            if not self._queue.qsize() and not self.active_slots:
+        gen = self._gen
+        while not self._stop and gen == self._gen:
+            self._last_progress = time.monotonic()
+            if not self.has_work():
                 with self._cv:
-                    if not self._stop and not self._queue.qsize():
+                    if (
+                        not self._stop
+                        and not self._queue.qsize()
+                        and not self._requeue
+                    ):
                         self._cv.wait(timeout=0.05)
                 continue
             try:
-                self.step()
+                _inj.inject("serve.loop.crash", context="scheduler loop")
+                self.step(gen=gen)
+            except _StaleEngine:
+                return  # a restart superseded this thread
+            except _inj.InjectedFault as e:
+                # chaos drill: the scheduler thread dies (loudly, but not as
+                # an unhandled thread exception); the supervisor sees a dead
+                # thread and restarts the engine
+                logger.error("engine scheduler crashed: %s", e)
+                return
             except Exception as e:  # poison every in-flight request, keep serving
-                self._pending_fetch.clear()
-                for s, req in enumerate(self._slot_req):
-                    if req is not None:
-                        req.error = e
-                        self._finish(s, req, "error")
+                with self._mu:
+                    if gen != self._gen:
+                        return
+                    self._pending_fetch.clear()
+                    for s, req in enumerate(self._slot_req):
+                        if req is not None:
+                            req.error = e
+                            self._finish(s, req, "error")
 
     # -- internals ----------------------------------------------------------
 
@@ -357,33 +784,102 @@ class ContinuousBatchingEngine:
         self.prefill_buckets.sort()
         return b
 
-    def _admit(self):
+    def _evict_expired(self, gen):
+        """Evict cancelled/deadline-expired slots at step granularity: flush
+        the tokens already dispatched, then recycle the slot (no recompile)
+        and resolve the request with its typed error."""
+        with self._mu:
+            self._check_gen(gen)
+            now = time.perf_counter()
+            victims = []
+            for s, req in enumerate(self._slot_req):
+                if req is None:
+                    continue
+                if req.cancelled:
+                    victims.append((s, req, "cancelled"))
+                elif req.expired(now):
+                    victims.append((s, req, "timeout"))
+            if not victims:
+                return
+            self._flush_pending_locked()  # emit what was already dispatched
+            for s, req, reason in victims:
+                if self._slot_req[s] is not req:
+                    continue  # resolved during the flush (eos/length/nan)
+                if reason == "cancelled":
+                    req.error = RequestCancelled(req.id, len(req.tokens))
+                else:
+                    req.error = DeadlineExceeded(
+                        req.id, len(req.tokens), req.max_new_tokens,
+                        req.deadline_s,
+                    )
+                self._finish(s, req, reason)
+
+    def _pop_request(self):
+        """Next admissible request (restart-requeued work first), resolving
+        dead-on-arrival entries (cancelled / already past deadline) without
+        burning a prefill.  Caller holds _mu."""
+        while True:
+            if self._requeue:
+                req = self._requeue.pop(0)
+            else:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    return None
+            self._queued_new_tokens -= req.max_new_tokens
+            if req.finished.is_set():
+                continue
+            if req.cancelled:
+                req.error = RequestCancelled(req.id, 0)
+                self._resolve(req, "cancelled")
+                continue
+            if req.expired():
+                req.error = DeadlineExceeded(
+                    req.id, 0, req.max_new_tokens, req.deadline_s
+                )
+                self._resolve(req, "timeout")
+                continue
+            return req
+
+    def _admit(self, gen):
         emitted = 0
         for s in range(self.slots):
-            if self._slot_req[s] is not None:
-                continue
+            with self._mu:
+                self._check_gen(gen)
+                if self._slot_req[s] is not None:
+                    continue
+                req = self._pop_request()
+                if req is None:
+                    break
+                self._admitting = req
+                req.state = "prefilling"
             try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            try:
-                self._prefill_into(s, req)
+                self._prefill_into(s, req, gen)
                 emitted += 1
+            except _StaleEngine:
+                raise  # the restart now owns this request — hands off
             except Exception as e:  # fail THIS request, keep the engine alive
                 req.error = e
-                if self._slot_req[s] is req:
-                    self._finish(s, req, "error")
-                else:
-                    req.finish_reason = "error"
-                    req.finished.set()
+                with self._mu:
+                    if self._slot_req[s] is req:
+                        self._finish(s, req, "error")
+                    else:
+                        self._resolve(req, "error")
+            finally:
+                with self._mu:
+                    if self._admitting is req:
+                        self._admitting = None
         return emitted
 
-    def _prefill_into(self, s, req):
+    def _prefill_into(self, s, req, gen):
         from .. import to_tensor
 
-        # the rebuild after this membership change reads _last_tok — it must
-        # reflect every step already dispatched
-        self._flush_pending()
+        with self._mu:
+            self._check_gen(gen)
+            # the rebuild after this membership change reads _last_tok — it
+            # must reflect every step already dispatched
+            self._flush_pending_locked()
+            key = self._key
         L = int(req.prompt.size)
         bucket = self._bucket_for(L)
         # cache rows run out at max_len: the last writable decode row is
@@ -391,75 +887,143 @@ class ContinuousBatchingEngine:
         req.max_new_tokens = min(req.max_new_tokens, self.max_len - L)
         toks = np.zeros((1, bucket), np.int32)
         toks[0, :L] = req.prompt
-        nxt, self._key = self._prefill_fn(
-            to_tensor(toks), to_tensor(np.int32(s)), to_tensor(np.int32(L)),
-            to_tensor(np.float32(req.temperature)), self._key,
-        )
-        tok = int(np.asarray(nxt.numpy()).reshape(-1)[0])
-        req.ttft_s = time.perf_counter() - req._submit_t
-        self._slot_req[s] = req
-        self._pos[s] = L
-        self._last_tok[s] = tok
-        self._temps[s] = req.temperature
-        self._dev = None  # membership changed: rebuild device loop state
-        self._emit(s, req, tok)
+        # dispatch OUTSIDE the mutex: the armed region (and the injected
+        # hang standing in for a wedged device) must not block submitters
+        # or a restart
+        with self._watchdog.arm(
+            "serve.prefill", timeout=self._wd_timeout(), context=f"req {req.id}"
+        ):
+            _inj.inject_hang("serve.prefill.hang", context=f"req {req.id}")
+            # a restart during the hang owns this request now — bail before
+            # dispatching a zombie prefill into the (shared) KV pool
+            self._check_gen(gen)
+            nxt, key = self._prefill_fn(
+                to_tensor(toks), to_tensor(np.int32(s)), to_tensor(np.int32(L)),
+                to_tensor(np.float32(req.temperature)), key,
+            )
+            tok = int(np.asarray(nxt.numpy()).reshape(-1)[0])
+        with self._mu:
+            self._check_gen(gen)  # a restart while we dispatched owns req now
+            self._key = key
+            req.ttft_s = time.perf_counter() - req._submit_t
+            self._slot_req[s] = req
+            self._pos[s] = L
+            self._last_tok[s] = tok
+            self._temps[s] = req.temperature
+            req.state = "decoding"
+            self._dev = None  # membership changed: rebuild device loop state
+            self._emit(s, req, tok)
 
-    def _decode_once(self):
+    def _decode_once(self, gen):
         from .. import profiler as _prof
         from .. import to_tensor
 
-        active_idx = [s for s in range(self.slots) if self._slot_req[s] is not None]
-        if not active_idx:
-            return 0
-        t0 = time.perf_counter()
-        if self._dev is None:
-            active = np.zeros(self.slots, bool)
-            active[active_idx] = True
-            self._dev = (
-                to_tensor(self._last_tok.reshape(self.slots, 1)),
-                to_tensor(self._pos.copy()), to_tensor(active),
-                to_tensor(self._temps.copy()),
-            )
-        toks_t, pos_t, active_t, temps_t = self._dev
-        nxt, new_pos, self._key = self._decode_fn(
-            toks_t, pos_t, active_t, temps_t, self._key
-        )
-        self._dev = (nxt, new_pos, active_t, temps_t)
-        for s in active_idx:
-            self._pos[s] += 1
-        # fetch to host only when something needs the values this step — a
-        # per-token consumer (EOS watch, streaming callback) or a slot hitting
-        # its length bound.  Otherwise the step stays in flight and the sync
-        # lands at the next membership change, so XLA pipelines decode
-        # dispatches exactly like the lock-step generate loop.
-        self._pending_fetch.append((nxt, active_idx))
-        depth = len(self._pending_fetch)
-        if any(
-            self._slot_req[s].eos_token_id is not None
-            or self._slot_req[s].on_token is not None
-            or len(self._slot_req[s].tokens) + depth
-            >= self._slot_req[s].max_new_tokens
-            for s in active_idx
+        with self._mu:
+            self._check_gen(gen)
+            active_idx = [s for s in range(self.slots) if self._slot_req[s] is not None]
+            if not active_idx:
+                return 0
+            t0 = time.perf_counter()
+            if self._dev is None:
+                active = np.zeros(self.slots, bool)
+                active[active_idx] = True
+                self._dev = (
+                    to_tensor(self._last_tok.reshape(self.slots, 1)),
+                    to_tensor(self._pos.copy()), to_tensor(active),
+                    to_tensor(self._temps.copy()),
+                )
+            toks_t, pos_t, active_t, temps_t = self._dev
+            key = self._key
+            poison_t, poisoned = self._poison_zero, None
+            if _inj.should_fire("serve.decode.nan", context=f"slot {active_idx[0]}"):
+                poisoned = active_idx[0]
+                pz = np.zeros(self.slots, bool)
+                pz[poisoned] = True
+                poison_t = to_tensor(pz)
+        with self._watchdog.arm(
+            "serve.decode", timeout=self._wd_timeout(),
+            context=f"{len(active_idx)} active slots",
         ):
-            self._flush_pending()
-        _prof.record_serving_tick(
-            len(active_idx) / self.slots, self._queue.qsize(),
-            time.perf_counter() - t0,
-        )
+            nxt, new_pos, finite, key = self._decode_fn(
+                toks_t, pos_t, active_t, temps_t, poison_t, key
+            )
+        with self._mu:
+            self._check_gen(gen)
+            self._key = key
+            self._dev = (nxt, new_pos, active_t, temps_t)
+            for s in active_idx:
+                self._pos[s] += 1
+            # fetch to host only when something needs the values this step —
+            # a per-token consumer (EOS watch, streaming callback), a slot
+            # hitting its length bound, or a poisoned step that must be
+            # checked now.  Otherwise the step stays in flight and the sync
+            # lands at the next membership change, so XLA pipelines decode
+            # dispatches exactly like the lock-step loop.
+            self._pending_fetch.append((nxt, finite, active_idx, t0))
+            depth = len(self._pending_fetch)
+            if poisoned is not None or any(
+                self._slot_req[s].eos_token_id is not None
+                or self._slot_req[s].on_token is not None
+                or len(self._slot_req[s].tokens) + depth
+                >= self._slot_req[s].max_new_tokens
+                for s in active_idx
+            ):
+                self._flush_pending_locked()
+            _prof.record_serving_tick(
+                len(active_idx) / self.slots, self._queue.qsize(),
+                time.perf_counter() - t0,
+            )
         return len(active_idx)
 
-    def _flush_pending(self):
+    def _flush_pending_locked(self):
         """Fetch every dispatched-but-unfetched decode step and emit its
-        tokens.  Membership is constant across buffered steps (any change
-        flushes first), so each entry's active set is exact."""
+        tokens; a slot whose logit window went non-finite errors alone.
+        Membership is constant across buffered steps (any change flushes
+        first), so each entry's active set is exact.  Caller holds _mu; the
+        blocking fetch runs under the serve.fetch watchdog region and
+        re-checks the generation after it (a restart that could not take
+        the mutex may have superseded us mid-fetch)."""
+        from .. import profiler as _prof
+
         if not self._pending_fetch:
             return
+        gen0 = self._gen
         batches, self._pending_fetch = self._pending_fetch, []
-        for nxt, idx in batches:
-            nxt_np = np.asarray(nxt.numpy()).reshape(-1)
+        with self._watchdog.arm(
+            "serve.fetch", timeout=self._wd_timeout(),
+            context=f"{len(batches)} buffered steps",
+        ):
+            fetched = [
+                (
+                    np.asarray(nxt.numpy()).reshape(-1),
+                    np.asarray(fin.numpy()).reshape(-1),
+                    idx,
+                    t0,
+                )
+                for nxt, fin, idx, t0 in batches
+            ]
+        self._check_gen(gen0)
+        now = time.perf_counter()
+        # EWMA decode-round wall time: dispatch-to-fetch of this burst over
+        # its step count — feeds estimate_drain_s / Retry-After
+        per = (now - fetched[0][3]) / len(fetched)
+        self._step_ewma_s = (
+            per if self._step_ewma_s is None
+            else 0.8 * self._step_ewma_s + 0.2 * per
+        )
+        for nxt_np, fin_np, idx, _t0 in fetched:
             for s in idx:
                 req = self._slot_req[s]
                 if req is None:  # finished earlier in this flush
+                    continue
+                if not fin_np[s]:
+                    _prof.record_serving_fault("nonfinite")
+                    req.error = NonFiniteLogits(
+                        f"request {req.id}: non-finite logit window at "
+                        f"position {int(self._pos[s])} (slot {s}); the slot "
+                        "was evicted — co-batched requests are unaffected"
+                    )
+                    self._finish(s, req, "error")
                     continue
                 tok = int(nxt_np[s])
                 self._last_tok[s] = tok
@@ -478,10 +1042,6 @@ class ContinuousBatchingEngine:
             self._finish(s, req, "length")
 
     def _finish(self, s, req, reason):
-        from .. import profiler as _prof
-
-        req.finish_reason = reason
-        req._finish_t = time.perf_counter()
         # recycle immediately: no cache scrub needed — the slot's next
         # prefill overwrites rows [0, bucket) and decode masks the rest
         self._slot_req[s] = None
@@ -489,9 +1049,70 @@ class ContinuousBatchingEngine:
         self._last_tok[s] = 0
         self._temps[s] = 0.0
         self._dev = None  # membership changed: rebuild device loop state
-        if reason != "error":
+        self._resolve(req, reason)
+
+    def _resolve(self, req, reason):
+        """Terminal transition, exactly once: a request that already
+        resolved (restart raced an eviction, stop raced a finish) is left
+        untouched — never double-completed, never silently lost."""
+        from .. import profiler as _prof
+
+        if req.finished.is_set():
+            return
+        req.finish_reason = reason
+        req.state = reason
+        req._finish_t = time.perf_counter()
+        if reason in ("eos", "length"):
             _prof.record_serving_request(
                 req.ttft_s or 0.0, len(req.tokens),
                 req._finish_t - req._submit_t,
             )
+        elif reason == "timeout":
+            _prof.record_serving_fault("deadline_miss")
+        elif reason == "cancelled":
+            _prof.record_serving_fault("cancelled")
+        elif reason == "restarted":
+            _prof.record_serving_fault("restarted_requests")
         req.finished.set()
+
+    # -- debug invariants ----------------------------------------------------
+
+    def _check_invariants(self):
+        """FLAGS_serve_debug_invariants: loud failure instead of a silent
+        slot leak.  After a step: a free slot is fully recycled (pos,
+        last_tok, temps zeroed), an occupied slot holds exactly one LIVE
+        request at a position within the cache, and no request occupies two
+        slots."""
+        with self._mu:
+            seen = {}
+            for s, req in enumerate(self._slot_req):
+                if req is None:
+                    if self._pos[s] != 0 or self._temps[s] != 0.0:
+                        raise AssertionError(
+                            f"slot invariant: slot {s} is free but not "
+                            f"recycled (pos={int(self._pos[s])}, "
+                            f"temp={float(self._temps[s])})"
+                        )
+                    continue
+                if req.finished.is_set():
+                    raise AssertionError(
+                        f"slot invariant: slot {s} holds already-resolved "
+                        f"request {req.id} ({req.finish_reason})"
+                    )
+                if id(req) in seen:
+                    raise AssertionError(
+                        f"slot invariant: request {req.id} occupies slots "
+                        f"{seen[id(req)]} and {s}"
+                    )
+                seen[id(req)] = s
+                if not 0 < int(self._pos[s]) <= self.max_len:
+                    raise AssertionError(
+                        f"slot invariant: slot {s} (request {req.id}) at "
+                        f"position {int(self._pos[s])} outside (0, "
+                        f"{self.max_len}]"
+                    )
+            if self._queued_new_tokens < 0:
+                raise AssertionError(
+                    "slot invariant: queued-token accounting went negative "
+                    f"({self._queued_new_tokens})"
+                )

@@ -131,7 +131,23 @@ _serving_gauges = {
     "occupancy_sum": 0.0,
     "queue_depth_sum": 0,
     "queue_depth_max": 0,
+    "faults": {},  # serving fault-domain counters, by kind
 }
+
+# serving fault-domain counter kinds (PR 6): engine restarts, requests
+# failed by a restart, deadline evictions/admission rejections,
+# cancellations, and non-finite logit windows
+_SERVING_FAULT_KINDS = (
+    "restarts", "restarted_requests", "deadline_miss", "rejected_deadline",
+    "cancelled", "nonfinite",
+)
+
+
+def record_serving_fault(kind, n=1):
+    """Count one serving fault-domain event (see _SERVING_FAULT_KINDS;
+    unknown kinds are counted too so call sites never have to guard)."""
+    f = _serving_gauges["faults"]
+    f[kind] = f.get(kind, 0) + int(n)
 
 
 def record_serving_request(ttft_s, tokens, wall_s):
@@ -161,7 +177,7 @@ def reset_serving():
     g = _serving_gauges
     g.update(
         requests=0, tokens=0, ttfts_s=[], busy_s=0.0, ticks=0,
-        occupancy_sum=0.0, queue_depth_sum=0, queue_depth_max=0,
+        occupancy_sum=0.0, queue_depth_sum=0, queue_depth_max=0, faults={},
     )
 
 
@@ -187,6 +203,8 @@ def serving_summary():
         out["occupancy_mean"] = g["occupancy_sum"] / g["ticks"]
         out["queue_depth_avg"] = g["queue_depth_sum"] / g["ticks"]
         out["queue_depth_max"] = g["queue_depth_max"]
+    if g["faults"]:
+        out["faults"] = dict(g["faults"])
     return out
 
 
@@ -321,6 +339,11 @@ class Profiler:
                     qa=sv.get("queue_depth_avg", 0.0),
                     qm=sv.get("queue_depth_max", 0),
                 )
+            )
+        if sv.get("faults"):
+            print(
+                "serving faults: "
+                + "  ".join(f"{k} {v}" for k, v in sorted(sv["faults"].items()))
             )
         # compile caches dominate cold-start cost: surface them next to the
         # step timing so "why was the first step slow" is answerable here

@@ -1,0 +1,440 @@
+"""Serving fault domain (ISSUE 6): request deadlines + cancellation,
+watchdogged engine supervision with warm restart (0 fresh compiles), NaN
+poison isolation, graceful drain, and the exactly-once resolution contract.
+
+Chaos drills run the REAL recovery path: faults are armed through the same
+FLAGS_fault_inject registry production uses, and every assertion is
+deterministic — fault shots are counted, sampling is greedy, and the warm
+restart must reproduce the exact tokens of an unfaulted run.
+"""
+
+import signal
+import threading
+import time
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference
+from paddle_tpu.fault import EngineSupervisor
+from paddle_tpu.fault import injection as finj
+from paddle_tpu.inference.engine import (
+    ContinuousBatchingEngine,
+    DeadlineExceeded,
+    DeadlineUnattainable,
+    EngineRestarted,
+    EngineUnavailable,
+    NonFiniteLogits,
+    RequestCancelled,
+)
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="module")
+def model():
+    np.random.seed(1234)
+    return LlamaForCausalLM(LlamaConfig.tiny())
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    yield
+    finj.disarm()
+    paddle.set_flags({
+        "FLAGS_serve_step_timeout_sec": 0.0,
+        "FLAGS_fault_hang_sec": 3600.0,
+        "FLAGS_serve_debug_invariants": False,
+    })
+
+
+def _prompt(n, seed=0):
+    return np.random.RandomState(seed).randint(1, 250, size=n).astype(np.int32)
+
+
+def _engine(model, **kw):
+    kw.setdefault("slots", 3)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prefill_buckets", [8, 16])
+    kw.setdefault("queue_depth", 16)
+    kw.setdefault("seed", 0)
+    return ContinuousBatchingEngine(model, **kw)
+
+
+def _ref(model, p, n):
+    return model.generate(paddle.to_tensor(p[None]), max_new_tokens=n).numpy()[0]
+
+
+# ---------------------------------------------------------------------------
+# request lifecycle: wait timeouts, cancellation, deadlines
+# ---------------------------------------------------------------------------
+
+
+def test_wait_timeout_names_request_and_state(model):
+    eng = _engine(model)
+    r = eng.submit(_prompt(4), max_new_tokens=8)
+    with pytest.raises(TimeoutError) as ei:
+        r.wait(0.01)  # scheduler not running: stays queued
+    assert f"request {r.id}" in str(ei.value)
+    assert "state=queued" in str(ei.value)
+    assert "0/8 tokens" in str(ei.value)
+    eng.step()  # admit + first decode: now decoding
+    with pytest.raises(TimeoutError) as ei:
+        r.wait(0.01)
+    assert "state=decoding" in str(ei.value)
+    eng.run_until_idle()
+    assert len(r.wait(1)) == 4 + 8  # and the handle still resolves normally
+
+
+def test_cancel_queued_resolves_without_slot(model):
+    eng = _engine(model)
+    warm_counts = eng.compile_counts()
+    r = eng.submit(_prompt(4), max_new_tokens=8)
+    r.cancel()
+    eng.run_until_idle()
+    with pytest.raises(RequestCancelled):
+        r.wait(1)
+    assert r.finish_reason == "cancelled"
+    # never slotted, never prefilled: no executable was even traced
+    assert eng.compile_counts() == warm_counts
+
+
+def test_cancel_slotted_recycles_slot_for_next_request(model):
+    pa, pb = _prompt(5, seed=1), _prompt(5, seed=2)
+    eng = _engine(model, slots=1)  # one slot: B MUST reuse A's slot
+    ra = eng.submit(pa, max_new_tokens=40)
+    rb = eng.submit(pb, max_new_tokens=6)
+    eng.step()  # A admitted and decoding
+    ra.cancel()
+    eng.run_until_idle()
+    with pytest.raises(RequestCancelled):
+        ra.wait(1)
+    assert ra.finish_reason == "cancelled"
+    assert 0 < len(ra.tokens) < 40  # partial stream, evicted mid-flight
+    # B lands in the recycled slot and is bit-identical to lock-step
+    assert np.array_equal(rb.wait(1), _ref(model, pb, 6))
+
+
+def test_deadline_eviction_zero_recompiles(model):
+    paddle.profiler.reset_serving()
+    eng = _engine(model, slots=2)
+    eng.warmup()
+    warm = eng.compile_counts()
+    pa, pb = _prompt(5, seed=3), _prompt(5, seed=4)
+    ra = eng.submit(pa, max_new_tokens=59, deadline_s=0.05)
+    rb = eng.submit(pb, max_new_tokens=6)
+    eng.step()  # both admitted, co-batched decode begins
+    time.sleep(0.1)  # A's deadline passes mid-flight
+    eng.run_until_idle()
+    with pytest.raises(DeadlineExceeded) as ei:
+        ra.wait(1)
+    assert ra.finish_reason == "timeout"
+    assert f"request {ra.id}" in str(ei.value)
+    # the co-batched request is untouched by the eviction (rows independent)
+    assert np.array_equal(rb.wait(1), _ref(model, pb, 6))
+    # eviction is slot recycling, not a new executable
+    assert eng.compile_counts() == warm
+    assert paddle.profiler.serving_summary()["faults"]["deadline_miss"] == 1
+
+
+def test_deadline_aware_admission(model):
+    paddle.profiler.reset_serving()
+    eng = _engine(model, slots=2, queue_depth=8)
+    # no evidence yet (no EWMA): every deadline is admitted
+    r0 = eng.submit(_prompt(4), max_new_tokens=4, deadline_s=0.001)
+    assert r0.state == "queued"
+    # seeded decode-round estimate: 0.5 s/step => 4 queued tokens is 1s of
+    # backlog; adding 20 more makes ceil(24/2)*0.5 = 6s
+    eng._step_ewma_s = 0.5
+    eng.submit(_prompt(4), max_new_tokens=20)
+    with pytest.raises(DeadlineUnattainable) as ei:
+        eng.submit(_prompt(4), max_new_tokens=4, deadline_s=2.0)
+    assert ei.value.retry_after_s > 2.0
+    # an attainable deadline still admits
+    r = eng.submit(_prompt(4), max_new_tokens=4, deadline_s=60.0)
+    assert r.state == "queued"
+    assert paddle.profiler.serving_summary()["faults"]["rejected_deadline"] == 1
+
+
+# ---------------------------------------------------------------------------
+# chaos drills: hang -> watchdog -> warm restart, NaN isolation, loop crash
+# ---------------------------------------------------------------------------
+
+
+def test_prefill_hang_watchdog_restart_bit_identical(model):
+    """The marquee drill: an injected prefill hang trips the serving
+    watchdog, the supervisor performs ONE warm restart, the hung request is
+    re-queued (it had emitted nothing) and both requests complete with the
+    exact tokens of an unfaulted run — with zero fresh compiles."""
+    paddle.profiler.reset_serving()
+    eng = _engine(model, slots=2)
+    eng.warmup()
+    warm = eng.compile_counts()
+    pa, pb = _prompt(5, seed=7), _prompt(9, seed=8)
+    ref_a, ref_b = _ref(model, pa, 6), _ref(model, pb, 6)
+
+    paddle.set_flags({
+        "FLAGS_serve_step_timeout_sec": 0.2,
+        "FLAGS_fault_hang_sec": 30.0,  # the WATCHDOG must end the hang
+    })
+    finj.arm("serve.prefill.hang")  # one shot: first prefill dispatch wedges
+    sup = EngineSupervisor(eng, poll_interval=0.02, max_restarts=3, backoff=0.0)
+    eng.start()
+    sup.start()
+    try:
+        ra = eng.submit(pa, max_new_tokens=6)
+        rb = eng.submit(pb, max_new_tokens=6)
+        out_a = ra.wait(timeout=30)
+        out_b = rb.wait(timeout=30)
+    finally:
+        sup.stop()
+        eng.stop(timeout=5)
+
+    assert np.array_equal(out_a, ref_a)
+    assert np.array_equal(out_b, ref_b)
+    assert ra.finish_reason == "length" and rb.finish_reason == "length"
+    assert eng.restart_count == 1 and sup.restarts == 1
+    assert eng.compile_counts() == warm  # warm restart: 0 fresh compiles
+    assert paddle.profiler.serving_summary()["faults"]["restarts"] == 1
+
+
+def test_decode_nan_poisons_only_target_slot(model):
+    """serve.decode.nan poisons ONE slot's logits as traced data: only that
+    request errors (NonFiniteLogits), the co-batched request's tokens are
+    bit-identical to an unpoisoned run, and the decode executable is never
+    re-traced (the poison mask is data)."""
+    paddle.profiler.reset_serving()
+    eng = _engine(model, slots=2)
+    eng.warmup()
+    warm = eng.compile_counts()
+    pa, pb = _prompt(5, seed=1), _prompt(9, seed=2)
+    ref_b = _ref(model, pb, 6)
+    ra = eng.submit(pa, max_new_tokens=6)
+    rb = eng.submit(pb, max_new_tokens=6)
+    eng.step()  # both admitted (slots 0, 1), first decode clean
+    finj.arm("serve.decode.nan")  # next decode poisons slot 0 (= ra)
+    eng.run_until_idle()
+    with pytest.raises(NonFiniteLogits) as ei:
+        ra.wait(1)
+    assert ra.finish_reason == "error"
+    assert f"request {ra.id}" in str(ei.value)
+    assert np.array_equal(rb.wait(1), ref_b)  # co-batched row unaffected
+    assert eng.compile_counts() == warm
+    assert paddle.profiler.serving_summary()["faults"]["nonfinite"] == 1
+
+
+def test_loop_crash_supervisor_restarts_thread(model):
+    eng = _engine(model, slots=2)
+    eng.warmup()
+    warm = eng.compile_counts()
+    p = _prompt(5, seed=9)
+    ref = _ref(model, p, 5)
+    finj.arm("serve.loop.crash")  # one shot: scheduler thread dies
+    sup = EngineSupervisor(eng, poll_interval=0.02, max_restarts=3, backoff=0.0)
+    eng.start()
+    sup.start()
+    try:
+        r = eng.submit(p, max_new_tokens=5)
+        out = r.wait(timeout=30)
+    finally:
+        sup.stop()
+        eng.stop(timeout=5)
+    assert np.array_equal(out, ref)
+    assert eng.restart_count == 1
+    assert eng.compile_counts() == warm
+
+
+def test_restart_budget_exhausted_fails_all_typed(model):
+    """Past the restart budget the engine goes DEAD: every pending request
+    resolves exactly once with the typed EngineRestarted error (no hangs),
+    and new submits raise EngineUnavailable."""
+    eng = _engine(model, slots=2)
+    finj.arm("serve.loop.crash:*")  # every scheduler life dies immediately
+    sup = EngineSupervisor(eng, poll_interval=0.01, max_restarts=2, backoff=0.0)
+    eng.start()
+    sup.start()
+    try:
+        r = eng.submit(_prompt(4), max_new_tokens=4)
+        with pytest.raises(EngineRestarted):
+            r.wait(timeout=30)
+    finally:
+        sup.stop()
+        eng.stop(timeout=5)
+    assert r.finish_reason == "restarted"
+    assert sup.dead
+    assert eng.restart_count == 2  # budget honored, then fail_all
+    with pytest.raises(EngineUnavailable):
+        eng.submit(_prompt(4), max_new_tokens=2)
+
+
+# ---------------------------------------------------------------------------
+# slot-pool invariant checker (FLAGS_serve_debug_invariants)
+# ---------------------------------------------------------------------------
+
+
+def test_invariant_checker_clean_traffic_passes(model):
+    paddle.set_flags({"FLAGS_serve_debug_invariants": True})
+    eng = _engine(model, slots=2)
+    reqs = [
+        eng.submit(_prompt(3 + 2 * i, seed=50 + i), max_new_tokens=2 + i)
+        for i in range(4)
+    ]
+    eng.run_until_idle()  # every step re-checks the pool
+    for r in reqs:
+        assert r.wait(1) is not None
+
+
+def test_invariant_checker_catches_corruption(model):
+    eng = _engine(model, slots=2)
+    eng._pos[0] = 7  # free slot left un-recycled: a would-be slot leak
+    with pytest.raises(AssertionError, match="free but not recycled"):
+        eng._check_invariants()
+    eng._pos[0] = 0
+    paddle.set_flags({"FLAGS_serve_debug_invariants": True})
+    eng.step()  # clean again: step-granularity check passes
+
+
+# ---------------------------------------------------------------------------
+# stop()/lifecycle hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_stop_flushes_pending_token_fetches(model):
+    eng = _engine(model, slots=1)
+    r = eng.submit(_prompt(4), max_new_tokens=30)
+    for _ in range(5):
+        eng.step()  # 1 prefill token + 4 decode dispatches, none fetched
+    assert len(r.tokens) == 1  # decode steps buffered in flight
+    eng.stop()
+    assert len(r.tokens) == 6  # stop() flushed every dispatched token
+
+
+def test_engine_context_manager_joins_thread(model):
+    stream = []
+    with _engine(model) as eng:
+        eng.start()
+        t = eng._thread
+        r = eng.submit(_prompt(4), max_new_tokens=5, on_token=stream.append)
+        r.wait(timeout=30)
+    assert eng._thread is None and not t.is_alive()
+    assert stream == list(r.tokens)
+
+
+# ---------------------------------------------------------------------------
+# serve() lifecycle: /healthz, Retry-After, SIGTERM drain
+# ---------------------------------------------------------------------------
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30
+        ) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _post(port, body, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        json.dumps(body).encode(),
+        {"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def test_healthz_reports_engine_state(model):
+    eng = _engine(model, slots=2)
+    eng.warmup()
+    srv = inference.serve(eng, port=0, block=False, supervise=False,
+                          handle_signals=False)
+    port = srv.server_address[1]
+    try:
+        status, body, _ = _get(port, "/healthz")
+        assert status == 200
+        assert body["status"] == "ready"
+        assert body["slots"] == 2 and body["active_slots"] == 0
+        assert body["queue_depth"] == 0 and body["restarts"] == 0
+    finally:
+        srv.shutdown()
+        eng.stop()
+
+
+def test_sigterm_drain_finishes_inflight_and_sheds_new(model):
+    """SIGTERM → drain: /healthz flips to draining, new work sheds with 503
+    + Retry-After, in-flight requests finish within the grace, the engine
+    stops cleanly, and the previous SIGTERM handler is restored."""
+    prev = signal.getsignal(signal.SIGTERM)
+    eng = _engine(model, slots=2, queue_depth=8)
+    eng.warmup()
+    eng._step_ewma_s = 0.01  # evidence for a nonzero Retry-After estimate
+    srv = inference.serve(eng, port=0, block=False, supervise=False,
+                          handle_signals=True)  # pytest main thread: installs
+    port = srv.server_address[1]
+    try:
+        p = _prompt(5, seed=11)
+        r = eng.submit(p, max_new_tokens=50)  # in-flight across the drain
+        signal.raise_signal(signal.SIGTERM)
+        status, body, _ = _get(port, "/healthz")
+        assert status == 503 and body["status"] == "draining"
+        status, body, headers = _post(
+            port, {"input_ids": _prompt(4).tolist(), "max_new_tokens": 2}
+        )
+        assert status == 503 and "error" in body
+        assert int(headers.get("Retry-After", 0)) >= 1
+        with pytest.raises(EngineUnavailable):
+            eng.submit(_prompt(4), max_new_tokens=2)
+        th = srv.drain()  # idempotent: hands back the worker to join
+        th.join(timeout=60)
+        assert not th.is_alive()
+        out = r.wait(1)  # the in-flight request finished within the grace
+        assert len(out) == 5 + 50 and r.finish_reason == "length"
+        assert eng._thread is None  # engine stopped by the drain
+    finally:
+        srv.shutdown()
+        eng.stop()
+        signal.signal(signal.SIGTERM, prev)
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+@pytest.mark.slow
+def test_http_chaos_drill_end_to_end(model):
+    """Full-stack drill: serve() under supervision, a prefill hang injected
+    mid-traffic; the client's POST must come back 200 with the exact tokens
+    of an unfaulted run, and /healthz must report the restart."""
+    paddle.set_flags({
+        "FLAGS_serve_step_timeout_sec": 0.2,
+        "FLAGS_fault_hang_sec": 30.0,
+    })
+    eng = _engine(model, slots=2)
+    eng.warmup()
+    warm = eng.compile_counts()
+    p = _prompt(5, seed=21)
+    ref = _ref(model, p, 6)
+    srv = inference.serve(eng, port=0, block=False, supervise=True,
+                          handle_signals=False)
+    port = srv.server_address[1]
+    try:
+        finj.arm("serve.prefill.hang")
+        status, body, _ = _post(
+            port, {"input_ids": p.tolist(), "max_new_tokens": 6}, timeout=60
+        )
+        assert status == 200
+        assert body["tokens"] == ref.tolist()
+        status, body, _ = _get(port, "/healthz")
+        assert status == 200
+        assert body["restarts"] == 1
+        assert eng.compile_counts() == warm
+    finally:
+        srv.supervisor.stop()
+        srv.shutdown()
+        eng.stop(timeout=5)

@@ -55,6 +55,18 @@ def _on_tpu():
     return jax.default_backend() == "tpu"
 
 
+def throughput_gate(value, minimum, enforced, key="min_steps_per_sec"):
+    """Per-config regression gate: {key: bar, enforced, ok}.  `ok` is True
+    when the bar is cleared OR the gate is unenforced (CPU CI throughput is
+    noise; gates bind on the TPU chip).  main() exits nonzero when any
+    enforced gate fails — after printing the full matrix, so the numbers
+    behind the failure are always in the output.  Kept as a plain function
+    so the gate logic itself is unit-testable without a TPU."""
+    gate = {key: float(minimum), "enforced": bool(enforced)}
+    gate["ok"] = bool(value >= gate[key]) or not gate["enforced"]
+    return gate
+
+
 def _time_steps(step_fn, ids, steps):
     """Returns (measurement window seconds, time_to_first_step seconds).
     The first-step time includes trace+compile — the cold-start cost the
@@ -281,9 +293,10 @@ def bench_lenet_eager():
     dt = time.perf_counter() - t0
     value = round(n / dt, 1)
     # regression gate (ROADMAP watch item: 65.3 -> 42.0 steps/s r04 -> r05
-    # on TPU).  Enforced only on the TPU chip — CPU CI throughput is noise.
-    gate = {"min_steps_per_sec": 55.0, "enforced": _on_tpu()}
-    gate["ok"] = (value >= gate["min_steps_per_sec"]) or not gate["enforced"]
+    # on TPU; traced to serving-leg process state bleeding into this config
+    # plus per-call module lookups in the eager dispatch salt — see
+    # ops/dispatch.py and the config ordering/gc in main()).
+    gate = throughput_gate(value, 55.0, _on_tpu())
     return {
         "metric": "lenet_eager_steps_per_sec",
         "value": value,
@@ -566,6 +579,183 @@ def bench_llama_serving():
         "note": "Poisson arrivals, log-uniform request lengths; slot-pooled "
         "continuous batching vs lock-step batches of `slots` (each row pays "
         "its batch's max length); tokens/s counts requested tokens only",
+    }
+
+
+def bench_paged_serving():
+    """Paged KV + copy-on-write prefix sharing vs dense slots (ISSUE 7),
+    under the SAME simulated KV budget: the dense engine gets `dense_slots`
+    full-length KV buffers; the paged engine gets a page pool holding
+    exactly that many rows but twice the slots, and must cover the extra
+    concurrency out of paging (requests only occupy their lifetime span)
+    plus prefix sharing (70% of requests open with one of 4 system prompts,
+    whose pages are mapped copy-free on a cache hit).  Gates: >= 2x peak
+    concurrent sequences vs dense, and shared-prefix TTFT p50 reduced
+    >= 30% (prefill only the unshared suffix)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler
+    from paddle_tpu.inference.engine import ContinuousBatchingEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    on_tpu = _on_tpu()
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000,
+            hidden_size=2048,
+            intermediate_size=5632,
+            num_hidden_layers=12,
+            num_attention_heads=16,
+            num_key_value_heads=16,
+            max_position_embeddings=2048,
+        )
+        dense_slots, n_req, sys_len, sfx, lo, hi = 4, 48, 96, 32, 16, 128
+        page_size, mean_gap = 32, 0.002
+    else:
+        cfg = LlamaConfig.tiny(
+            hidden_size=256, intermediate_size=512, num_hidden_layers=4,
+            num_attention_heads=8, num_key_value_heads=8,
+        )
+        # hi >> lo is the dense-waste regime: dense commits max_len rows per
+        # slot for requests that mostly stop near `lo`, paged only spends
+        # pages on each request's actual lifetime span
+        dense_slots, n_req, sys_len, sfx, lo, hi = 2, 24, 24, 8, 4, 64
+        page_size, mean_gap = 8, 0.0003
+
+    prompt_len = sys_len + sfx
+    max_len = prompt_len + hi
+    budget_rows = dense_slots * max_len  # what the dense engine commits
+    pool_pages = budget_rows // page_size + 1  # +1: permanent scratch page
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model = paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+
+    # 70% of requests share one of 4 system prompts (>= min_prefix_match
+    # tokens) followed by a unique suffix; 30% are fully unique.  Greedy
+    # decoding so the two engines' outputs are comparable token-for-token.
+    rng = np.random.RandomState(0)
+    sys_prompts = rng.randint(0, cfg.vocab_size, (4, sys_len))
+    shared = rng.rand(n_req) < 0.7
+    sys_ids = rng.randint(0, 4, size=n_req)
+    prompts = []
+    for i in range(n_req):
+        tail = rng.randint(0, cfg.vocab_size, (sfx,))
+        if shared[i]:
+            prompts.append(np.concatenate([sys_prompts[sys_ids[i]], tail]))
+        else:
+            prompts.append(rng.randint(0, cfg.vocab_size, (prompt_len,)))
+    new_toks = np.exp(
+        rng.uniform(np.log(lo), np.log(hi + 1), size=n_req)
+    ).astype(np.int64).clip(lo, hi)
+    gaps = rng.exponential(mean_gap, size=n_req)
+
+    def _run(eng):
+        eng.warmup()
+        profiler.reset_serving()
+        profiler.reset_paging()
+        eng.start()
+        handles = []
+        t0 = time.perf_counter()
+        for i in range(n_req):
+            time.sleep(gaps[i])
+            handles.append(
+                eng.submit(
+                    prompts[i].astype(np.int32),
+                    max_new_tokens=int(new_toks[i]),
+                    temperature=0.0,
+                )
+            )
+        for h in handles:
+            h.wait(timeout=600)
+        wall = time.perf_counter() - t0
+        sv, pg = profiler.serving_summary(), profiler.paging_summary()
+        # unloaded sequential TTFT probes on the still-running engine:
+        # queue-free, so TTFT is pure admission + prefill latency — the
+        # channel prefix caching actually cuts (it prefills only the
+        # unshared suffix once the system prompt's pages are cached)
+        probes = []
+        for i in range(n_req):
+            if not shared[i]:
+                continue
+            h = eng.submit(
+                prompts[i].astype(np.int32), max_new_tokens=2, temperature=0.0
+            )
+            h.wait(timeout=600)
+            probes.append(h.ttft_s)
+        eng.stop()
+        return wall, sv, pg, handles, sorted(probes)
+
+    dense_eng = ContinuousBatchingEngine(
+        model, slots=dense_slots, max_len=max_len,
+        prefill_buckets=[prompt_len], queue_depth=n_req, seed=0, paged=False,
+    )
+    d_wall, d_sv, _, d_handles, d_probes = _run(dense_eng)
+
+    paged_eng = ContinuousBatchingEngine(
+        model, slots=2 * dense_slots, max_len=max_len,
+        prefill_buckets=[sfx, prompt_len], queue_depth=n_req, seed=0,
+        paged=True, page_size=page_size, pool_pages=pool_pages,
+        prefix_cache=True,
+    )
+    p_wall, p_sv, p_pg, p_handles, p_probes = _run(paged_eng)
+
+    d_tok = sum(len(h.tokens) for h in d_handles)
+    p_tok = sum(len(h.tokens) for h in p_handles)
+    d_concurrent = d_sv.get("occupancy_peak", 0.0) * dense_slots
+    p_concurrent = p_sv.get("occupancy_peak", 0.0) * 2 * dense_slots
+    ratio = p_concurrent / max(d_concurrent, 1.0)
+    d_shared_p50 = d_probes[len(d_probes) // 2] if d_probes else 0.0
+    p_shared_p50 = p_probes[len(p_probes) // 2] if p_probes else 0.0
+    reduction = 1.0 - p_shared_p50 / d_shared_p50 if d_shared_p50 > 0 else 0.0
+    # both acceptance bars ride one gate dict (main() checks one per config)
+    g_conc = throughput_gate(ratio, 2.0, on_tpu, key="min_concurrency_ratio")
+    g_ttft = throughput_gate(
+        reduction, 0.30, on_tpu, key="min_shared_ttft_reduction"
+    )
+    gate = {**g_conc, **g_ttft, "enforced": on_tpu,
+            "ok": g_conc["ok"] and g_ttft["ok"]}
+
+    return {
+        "metric": "paged_vs_dense_concurrency_ratio",
+        "value": round(ratio, 3),
+        "unit": "x",
+        "kv_budget_rows": budget_rows,
+        "dense": {
+            "slots": dense_slots,
+            "tokens_per_sec": round(d_tok / d_wall, 1),
+            "ttft_p50_ms": round(d_sv.get("ttft_p50_ms", 0.0), 2),
+            "ttft_p95_ms": round(d_sv.get("ttft_p95_ms", 0.0), 2),
+            "peak_concurrent": round(d_concurrent, 2),
+        },
+        "paged": {
+            "slots": 2 * dense_slots,
+            "page_size": page_size,
+            "pool_pages": pool_pages,
+            "tokens_per_sec": round(p_tok / p_wall, 1),
+            "ttft_p50_ms": round(p_sv.get("ttft_p50_ms", 0.0), 2),
+            "ttft_p95_ms": round(p_sv.get("ttft_p95_ms", 0.0), 2),
+            "peak_concurrent": round(p_concurrent, 2),
+            "prefix_hit_rate": round(p_pg.get("prefix_hit_rate", 0.0), 3),
+            "prefill_tokens_saved": p_pg.get("prefill_tokens_saved", 0),
+            "cow_copies": p_pg.get("cow_copies", 0),
+            "pages_used_peak": p_pg.get("pages_used_peak", 0),
+            "pages_total": p_pg.get("pages_total", 0),
+            "compiles": paged_eng.compile_counts(),
+        },
+        "shared_ttft_probe_p50_ms": {  # unloaded sequential probes, cache warm
+            "dense": round(d_shared_p50 * 1e3, 2),
+            "paged": round(p_shared_p50 * 1e3, 2),
+            "reduction": round(reduction, 3),
+        },
+        "greedy_outputs_match": bool(
+            all(dh.tokens == ph.tokens for dh, ph in zip(d_handles, p_handles))
+        ),
+        "flash_fallbacks": profiler.flash_fallback_summary(),
+        "gate": gate,
+        "note": "same KV rows both sides; dense commits slots*max_len up "
+        "front, paged spends pages on lifetime spans and maps 70%-shared "
+        "system prompts copy-free, so it runs 2x the slots in the budget",
     }
 
 
@@ -891,12 +1081,20 @@ def main():
 
     headline = bench_llama()
     configs = {}
+    # lenet_eager runs BEFORE the serving legs: the r05 lenet regression
+    # (65.3 -> 42.0 steps/s) was partly serving-engine process state (live
+    # scheduler threads, device allocations, executable caches) bleeding
+    # into the eager-dispatch measurement.  gc between configs for the same
+    # reason — each config's numbers should not depend on its neighbours.
+    import gc
+
     for name, fn in (
         ("resnet50_amp_o2", bench_resnet50),
         ("bert_base_qa", bench_bert),
+        ("lenet_eager", bench_lenet_eager),
         ("llama_decode", bench_llama_decode),
         ("llama_serving", bench_llama_serving),
-        ("lenet_eager", bench_lenet_eager),
+        ("paged_serving", bench_paged_serving),
         ("hapi_async", bench_hapi_async),
         ("moe_gshard", bench_moe),
     ):
@@ -904,6 +1102,8 @@ def main():
             configs[name] = fn()
         except Exception as e:  # record honestly, don't fail the headline
             configs[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        finally:
+            gc.collect()
     if _on_tpu():
         try:
             configs["llama_deep_remat"] = bench_llama(deep=True)

@@ -27,6 +27,14 @@ if [ "$MODE" = "chaos-serve" ]; then
   timeout -k 30 900 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
       python -m pytest tests/test_serving_fault.py \
       -q -p no:cacheprovider
+  echo "== paged-KV warm-restart drill (ISSUE 7) =="
+  # warm restart must preserve the prefix cache AND the compiled set: the
+  # first shared-prefix request after restart() is a cache hit served with
+  # 0 fresh compiles
+  timeout -k 30 600 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python -m pytest \
+      "tests/test_paged_kv.py::test_warm_restart_preserves_prefix_cache_no_recompile" \
+      -q -p no:cacheprovider
   echo "CHAOS-SERVE OK"
   exit 0
 fi
@@ -88,6 +96,16 @@ SERVE_TESTS=(tests/test_serving_engine.py::test_zero_recompiles_after_warmup
 [ "$MODE" != "fast" ] && SERVE_TESTS=(tests/test_serving_engine.py)
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python -m pytest "${SERVE_TESTS[@]}" -q -p no:cacheprovider
+
+echo "== paged-KV smoke (ISSUE 7 acceptance subset) =="
+# both tiers: paged arena bit-identical to dense slots on mixed traffic,
+# and zero recompiles under prefix-hit traffic (COW copies + chunk prefills
+# ride warmed executables); fast mode runs that pair, full mode the file
+PAGED_TESTS=(tests/test_paged_kv.py::test_paged_matches_dense_mixed_traffic
+             tests/test_paged_kv.py::test_zero_recompiles_with_prefix_traffic)
+[ "$MODE" != "fast" ] && PAGED_TESTS=(tests/test_paged_kv.py)
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python -m pytest "${PAGED_TESTS[@]}" -q -p no:cacheprovider
 
 echo "== serving fault drills (ISSUE 6 acceptance subset) =="
 # both tiers run the deterministic core of the serving fault domain: the

@@ -463,7 +463,34 @@ define_flag(
     "FLAGS_serve_debug_invariants", False,
     "after every scheduler step assert slot-pool invariants (no slot both "
     "free and active, one live request per slot, positions <= max_len) — "
-    "turns silent slot leaks into loud failures in tests/CI",
+    "turns silent slot leaks into loud failures in tests/CI.  With paged KV "
+    "it additionally audits the page pool: refcounts match the slot tables "
+    "plus prefix-cache holds, the free list is exact, no page leaks",
+)
+define_flag(
+    "FLAGS_serve_paged_kv", True,
+    "continuous-batching engine: back the KV cache with a block-paged pool "
+    "(per-slot page tables as traced data) instead of dense per-slot "
+    "buffers; False restores the dense slot pool (the bit-identity oracle)",
+)
+define_flag(
+    "FLAGS_serve_kv_page_size", 128,
+    "paged KV: tokens per page.  Clamped to the engine max_len; every "
+    "sequence holds ceil(len/page_size) pages instead of a dense max_len "
+    "row, which is where the concurrency win comes from",
+)
+define_flag(
+    "FLAGS_serve_kv_pool_pages", 0,
+    "paged KV: total pages in the pool (page 0 is a permanent scratch page "
+    "for masked/inactive writes).  0 = auto: slots * pages_per_seq + 1, the "
+    "same HBM budget as the dense slot pool",
+)
+define_flag(
+    "FLAGS_serve_prefix_cache", True,
+    "paged KV: keep committed prompt pages in a host-side prefix index so a "
+    "request sharing a cached prefix maps those pages read-only (refcounted, "
+    "copy-on-write into partially filled pages) and prefills only its "
+    "unshared suffix",
 )
 
 

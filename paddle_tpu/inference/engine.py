@@ -29,6 +29,25 @@ Compiled-executable budget: len(prefill_buckets) + 1 (asserted by tests via
 compile cache and AOT snapshots apply per bucket: a restarted server binds
 the previous process's executables without tracing.
 
+Paged KV (ISSUE 7, default on via FLAGS_serve_paged_kv): instead of one
+dense `[slots, max_len, ...]` buffer per layer, K/V live in a block-paged
+ARENA `[num_pages, page_size, kv_heads, head_dim]` addressed through
+per-slot page tables (`[slots, max_pages_per_seq]` int32) that ride the
+compiled steps as DATA — join/finish/recycle still cause zero recompiles.
+A request only occupies pages covering `prompt + max_new_tokens`, so the
+same KV budget serves far more concurrent sequences than `slots * max_len`
+dense rows.  A host-side `PrefixCache` indexes committed prompt pages:
+a request sharing a cached prefix maps the shared full pages READ-ONLY
+(refcounted), copy-on-writes only a partially filled shared page, and
+prefills just the unshared suffix through a chunk-prefill executable
+(rope offset and page table as data).  The compiled budget becomes
+2 * len(buckets) + 2 (fresh + chunk per bucket, decode, page copy); the
+`compile_counts()` contract keys are prefill/chunk_prefill/decode/copy.
+Admission gates on pages: submit raises QueueFull when a request's worst
+case page need exceeds the pool, and the scheduler defers admission (the
+request stays at the head of the line) until free + cache-evictable pages
+cover it.  Restart keeps the pool AND the prefix cache warm.
+
 Serving fault domain (the serving mirror of the training fault domain):
 
 - **Request lifecycle** — every submitted request resolves EXACTLY once:
@@ -70,8 +89,15 @@ import numpy as np
 from ..fault import injection as _inj
 from ..fault import watchdog as _wd
 from ..framework import core as _fcore
-from ..models.llama import SlotView, StaticKVCache
+from ..models.llama import (
+    PagedDecodeView,
+    PagedKVCache,
+    PagedPrefillView,
+    SlotView,
+    StaticKVCache,
+)
 from ..tensor import Tensor
+from .paging import PagePool, PrefixCache
 
 logger = logging.getLogger("paddle_tpu")
 
@@ -218,7 +244,8 @@ class ContinuousBatchingEngine:
     """
 
     def __init__(self, model, slots=None, max_len=None, prefill_buckets=None,
-                 queue_depth=None, seed=0):
+                 queue_depth=None, seed=0, paged=None, page_size=None,
+                 pool_pages=None, prefix_cache=None):
         import jax
 
         from .. import jit, to_tensor
@@ -248,13 +275,61 @@ class ContinuousBatchingEngine:
 
         head_dim = cfg.hidden_size // cfg.num_attention_heads
         cache_dtype = model.lm_head.weight.dtype  # bf16 under AMP-O2 decorate
-        self._caches = [
-            StaticKVCache(self.slots, self.max_len, cfg.num_key_value_heads,
-                          head_dim, cache_dtype)
-            for _ in range(cfg.num_hidden_layers)
-        ]
-        self._decode_fn = jit.to_static(self._decode_body)
-        self._prefill_fn = jit.to_static(self._prefill_body)
+        self.paged = bool(
+            _fcore.flag("FLAGS_serve_paged_kv") if paged is None else paged
+        )
+        if self.paged:
+            ps = int(
+                page_size if page_size is not None
+                else _fcore.flag("FLAGS_serve_kv_page_size")
+            )
+            # a page never needs to exceed a sequence; clamping keeps the
+            # default flag sane for tiny test engines
+            self.page_size = max(1, min(ps, self.max_len))
+            self.pages_per_seq = -(-self.max_len // self.page_size)
+            pp = int(
+                pool_pages if pool_pages is not None
+                else _fcore.flag("FLAGS_serve_kv_pool_pages")
+            )
+            if pp <= 0:  # auto: every slot can hold a max_len sequence
+                pp = self.slots * self.pages_per_seq + 1
+            self.pool_pages = int(pp)
+            self._caches = None
+            self._arenas = [
+                PagedKVCache(self.pool_pages, self.page_size,
+                             cfg.num_key_value_heads, head_dim, cache_dtype)
+                for _ in range(cfg.num_hidden_layers)
+            ]
+            self._pool = PagePool(self.pool_pages)
+            use_prefix = bool(
+                _fcore.flag("FLAGS_serve_prefix_cache")
+                if prefix_cache is None else prefix_cache
+            )
+            self._prefix = PrefixCache(self.page_size) if use_prefix else None
+            # ignore sub-threshold matches: an accidental few-token overlap
+            # between unrelated prompts must not flip a request onto the
+            # chunk-prefill path (and its different first-token rounding)
+            self.min_prefix_match = 8
+            self._page_table = np.zeros(
+                (self.slots, self.pages_per_seq), np.int32
+            )
+            self._slot_pages = [[] for _ in range(self.slots)]
+            self._tables_t = None  # device mirror, rebuilt with _dev
+            self._decode_fn = jit.to_static(self._decode_paged_body)
+            self._prefill_fn = jit.to_static(self._prefill_paged_body)
+            self._chunk_fn = jit.to_static(self._chunk_prefill_body)
+            self._copy_fn = jit.to_static(self._copy_page_body)
+        else:
+            self._arenas = None
+            self._pool = None
+            self._prefix = None
+            self._caches = [
+                StaticKVCache(self.slots, self.max_len, cfg.num_key_value_heads,
+                              head_dim, cache_dtype)
+                for _ in range(cfg.num_hidden_layers)
+            ]
+            self._decode_fn = jit.to_static(self._decode_body)
+            self._prefill_fn = jit.to_static(self._prefill_body)
         self._key = to_tensor(np.asarray(jax.random.PRNGKey(int(seed))))
 
         # host-side slot table — mutated only under _mu, by the scheduler
@@ -365,6 +440,129 @@ class ContinuousBatchingEngine:
         nxt, key = apply(f, [logits, key, temp], multi=True, name="serve_sample1")
         return nxt, key
 
+    def _decode_paged_body(self, toks, pos, active, temps, poison, key, tables):
+        """_decode_body over the paged arena: identical math, but each slot's
+        K/V rows are gathered through its page-table row (`tables`
+        [slots, max_pages_per_seq] int32 — DATA, so remaps never retrace).
+        Bit-identical tokens to the dense decode given identical cache rows:
+        the gather reproduces the dense [slots, max_len] geometry exactly and
+        rows beyond `pos` are masked to zero weight either way."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.dispatch import apply
+
+        pos_eff = apply(
+            lambda p, a: jnp.where(a, p, 0), [pos, active], name="serve_pos_mask"
+        )
+        views = [PagedDecodeView(a, tables, self.max_len) for a in self._arenas]
+        hidden, _ = self.model.llama(toks, caches=views, pos=pos_eff)
+        logits = self.model.lm_head(hidden)[:, -1]  # [S, V]
+
+        def f(lg, ky, tp, p, a, po):
+            lgf = lg.astype(jnp.float32)
+            lgf = jnp.where(po[:, None], jnp.nan, lgf)
+            finite = jnp.all(jnp.isfinite(lgf), axis=-1) | ~a
+            greedy = jnp.argmax(lgf, axis=-1).astype(jnp.int32)
+            ky, sub = jax.random.split(ky)
+            samp = jax.random.categorical(
+                sub, lgf / jnp.maximum(tp, 1e-6)[:, None], axis=-1
+            ).astype(jnp.int32)
+            nxt = jnp.where(tp > 0.0, samp, greedy)
+            return nxt[:, None], jnp.where(a, p + 1, p), finite, ky
+
+        nxt, new_pos, finite, key = apply(
+            f, [logits, key, temps, pos, active, poison], multi=True,
+            name="serve_sample",
+        )
+        return nxt, new_pos, finite, key
+
+    def _prefill_paged_body(self, toks, row_table, true_len, temp, key):
+        """_prefill_body for a fresh paged prefill: the prompt attends to
+        itself causally (the exact dense-SlotView math — bit-identical first
+        tokens) while its K/V scatter into the pages of `row_table`
+        ([max_pages_per_seq] int32, data).  Padding rows land on scratch."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..ops.dispatch import apply
+
+        views = [
+            PagedPrefillView(a, row_table, true_len, self.max_len)
+            for a in self._arenas
+        ]
+        hidden, _ = self.model.llama(toks, caches=views)
+        h_last = apply(
+            lambda h, n: lax.dynamic_slice_in_dim(h, n - 1, 1, 1),
+            [hidden, true_len], name="serve_prefill_last",
+        )
+        logits = self.model.lm_head(h_last)[:, -1]  # [1, V]
+
+        def f(lg, ky, tp):
+            lgf = lg.astype(jnp.float32)
+            greedy = jnp.argmax(lgf, axis=-1).astype(jnp.int32)
+            ky, sub = jax.random.split(ky)
+            samp = jax.random.categorical(
+                sub, lgf / jnp.maximum(tp, 1e-6), axis=-1
+            ).astype(jnp.int32)
+            return jnp.where(tp > 0.0, samp, greedy), ky
+
+        nxt, key = apply(f, [logits, key, temp], multi=True, name="serve_sample1")
+        return nxt, key
+
+    def _chunk_prefill_body(self, toks, row_table, true_len, start, temp, key):
+        """Prefix-cache-hit prefill: only the UNSHARED suffix runs through
+        the model.  toks [1, bucket] holds the suffix (right-padded),
+        true_len its real length, start (int32[1], data) the absolute
+        position of suffix row 0 — suffix row i writes page
+        table[(start+i)//ps] and attends positions j <= start+i through the
+        table gather, shared prefix pages included."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..ops.dispatch import apply
+
+        views = [
+            PagedPrefillView(a, row_table, true_len, self.max_len, start=start)
+            for a in self._arenas
+        ]
+        hidden, _ = self.model.llama(toks, caches=views)
+        h_last = apply(
+            lambda h, n: lax.dynamic_slice_in_dim(h, n - 1, 1, 1),
+            [hidden, true_len], name="serve_prefill_last",
+        )
+        logits = self.model.lm_head(h_last)[:, -1]  # [1, V]
+
+        def f(lg, ky, tp):
+            lgf = lg.astype(jnp.float32)
+            greedy = jnp.argmax(lgf, axis=-1).astype(jnp.int32)
+            ky, sub = jax.random.split(ky)
+            samp = jax.random.categorical(
+                sub, lgf / jnp.maximum(tp, 1e-6), axis=-1
+            ).astype(jnp.int32)
+            return jnp.where(tp > 0.0, samp, greedy), ky
+
+        nxt, key = apply(f, [logits, key, temp], multi=True, name="serve_sample1")
+        return nxt, key
+
+    def _copy_page_body(self, src, dst):
+        """Copy-on-write: duplicate arena page `src` into `dst` (scalar int32
+        Tensors — data) across every layer's K and V, inside ONE compiled
+        dispatch.  Used exactly once per admission that extends a partially
+        filled shared page; decode never copies (frontier pages are always
+        exclusively owned)."""
+        from ..ops.dispatch import apply
+
+        def f(c, s_, d_):
+            return c.at[d_].set(c[s_])
+
+        for a in self._arenas:
+            a.k._data = apply(f, [a.k, src, dst], name="kv_page_copy")._data
+            a.v._data = apply(f, [a.v, src, dst], name="kv_page_copy")._data
+        return dst
+
     # -- public API ---------------------------------------------------------
 
     def submit(self, input_ids, max_new_tokens=32, temperature=0.0,
@@ -406,6 +604,19 @@ class ContinuousBatchingEngine:
                     f"queue-drain estimate {est:.2f}s",
                     retry_after_s=est,
                 )
+        if self.paged:
+            # page-aware admission: a request whose WORST-CASE page need
+            # (no prefix sharing assumed) exceeds the pool can never be
+            # scheduled — fail fast with the same 503 family the queue
+            # bound uses instead of parking it forever
+            need = self._pages_for(ids.size, max_new_tokens)
+            if need > self._pool.usable_pages:
+                raise QueueFull(
+                    f"request needs {need} KV pages (prompt {ids.size} + "
+                    f"max_new {max_new_tokens} at page size {self.page_size})"
+                    f" but the pool holds {self._pool.usable_pages}",
+                    retry_after_s=self.estimate_drain_s(),
+                )
         req = EngineRequest(
             next(self._req_ids), ids, max_new_tokens, temperature,
             eos_token_id, on_token, deadline_s=deadline_s,
@@ -446,6 +657,34 @@ class ContinuousBatchingEngine:
         """
         from .. import to_tensor
 
+        if self.paged:
+            # all-zero tables aim every warmup write at scratch page 0
+            zero_row = to_tensor(np.zeros(self.pages_per_seq, np.int32))
+            for b in self.prefill_buckets:
+                _, self._key = self._prefill_fn(
+                    to_tensor(np.zeros((1, b), np.int32)), zero_row,
+                    to_tensor(np.int32(b)), to_tensor(np.float32(0.0)),
+                    self._key,
+                )
+                _, self._key = self._chunk_fn(
+                    to_tensor(np.zeros((1, b), np.int32)), zero_row,
+                    to_tensor(np.int32(b)),
+                    to_tensor(np.zeros(1, np.int32)),
+                    to_tensor(np.float32(0.0)), self._key,
+                )
+            self._copy_fn(  # scratch onto itself: a no-op through the real fn
+                to_tensor(np.int32(0)), to_tensor(np.int32(0))
+            )
+            _, _, _, self._key = self._decode_fn(
+                to_tensor(np.zeros((self.slots, 1), np.int32)),
+                to_tensor(np.zeros(self.slots, np.int32)),
+                to_tensor(np.zeros(self.slots, bool)),
+                to_tensor(np.zeros(self.slots, np.float32)),
+                self._poison_zero,
+                self._key,
+                to_tensor(np.zeros((self.slots, self.pages_per_seq), np.int32)),
+            )
+            return self
         for b in self.prefill_buckets:
             _, self._key = self._prefill_fn(
                 to_tensor(np.zeros((1, b), np.int32)),
@@ -465,12 +704,20 @@ class ContinuousBatchingEngine:
     def compile_counts(self):
         """{prefill, decode} trace counts + AOT snapshot hits — the test
         contract is prefill == len(buckets used) and decode == 1, forever
-        (engine restarts included: restart rebinds the same executables)."""
-        return {
+        (engine restarts included: restart rebinds the same executables).
+        Paged engines add chunk_prefill (== buckets warmed) and copy (== 1):
+        prefix-cache hits and COW copies ride those executables with zero
+        fresh traces."""
+        out = {
             "prefill": self._prefill_fn.trace_count,
             "decode": self._decode_fn.trace_count,
             "aot_hits": self._prefill_fn.aot_hits + self._decode_fn.aot_hits,
         }
+        if self.paged:
+            out["chunk_prefill"] = self._chunk_fn.trace_count
+            out["copy"] = self._copy_fn.trace_count
+            out["aot_hits"] += self._chunk_fn.aot_hits + self._copy_fn.aot_hits
+        return out
 
     @property
     def active_slots(self):
@@ -661,6 +908,15 @@ class ContinuousBatchingEngine:
                 if req is None or req.finished.is_set():
                     continue
                 (requeue if not req.tokens else fail).append(req)
+            if self.paged:
+                # warm restart keeps the POOL and the PREFIX CACHE: only the
+                # per-slot mappings drop (an admission interrupted mid-
+                # dispatch also parked pages here — release those too, its
+                # stale thread bails at the generation fence).  Re-queued
+                # requests re-prefill and re-hit the cache.
+                for s in range(self.slots):
+                    self._release_slot_pages_locked(s)
+                self._tables_t = None
             self._pos[:] = 0
             self._last_tok[:] = 0
             self._temps[:] = 0.0
@@ -718,6 +974,10 @@ class ContinuousBatchingEngine:
                 except queue.Empty:
                     break
             self._queued_new_tokens = 0
+            if self.paged:
+                for s in range(self.slots):
+                    self._release_slot_pages_locked(s)
+                self._tables_t = None
             self._pos[:] = 0
             self._last_tok[:] = 0
             self._temps[:] = 0.0
@@ -783,6 +1043,58 @@ class ContinuousBatchingEngine:
         self.prefill_buckets.append(b)
         self.prefill_buckets.sort()
         return b
+
+    # -- paged-KV allocator ---------------------------------------------------
+
+    def _pages_for(self, prompt_len, max_new):
+        """Worst-case pages a request occupies over its whole lifetime (no
+        prefix sharing assumed): its positions span [0, L + max_new')."""
+        span = int(prompt_len) + min(int(max_new), self.max_len - int(prompt_len))
+        return -(-span // self.page_size)
+
+    def _page_headroom_locked(self):
+        """Pages obtainable without touching a live slot's mapping: the free
+        list plus every page only the prefix cache still holds (ref == 1 for
+        a cache-held page means no slot maps it; repeated leaf eviction can
+        always reach it).  Caller holds _mu."""
+        return self._page_fresh_headroom_locked(())
+
+    def _page_fresh_headroom_locked(self, exclude):
+        """Headroom available for FRESH allocations when the pages in
+        `exclude` (a request's matched prefix pages, about to be mapped by
+        incref) must stay resident: they cannot be counted as evictable or
+        the admission check double-counts them.  Caller holds _mu."""
+        free = self._pool.free_count()
+        if self._prefix is not None:
+            free += sum(
+                1 for e in self._prefix.entries()
+                if self._pool.refs[e.page] == 1 and e.page not in exclude
+            )
+        return free
+
+    def _alloc_page_locked(self):
+        """One fresh page, evicting LRU prefix-cache entries under pressure.
+        Only called after `_page_headroom_locked` covered the request, so the
+        eviction loop terminates with a page.  Caller holds _mu."""
+        from .. import profiler as _prof
+
+        while self._pool.free_count() == 0:
+            if self._prefix is None or self._prefix.evict_one(self._pool) is None:
+                raise RuntimeError(
+                    "KV page pool exhausted mid-admission — the headroom "
+                    "check should have deferred this request (accounting bug)"
+                )
+            _prof.record_paging_event("cache_evictions")
+        return self._pool.alloc()
+
+    def _release_slot_pages_locked(self, s):
+        """Drop slot `s`'s page mappings (finish/evict/restart): every mapped
+        page holds one ref for the mapping — shared prefix pages stay alive
+        through the cache's own hold.  Caller holds _mu."""
+        for p in self._slot_pages[s]:
+            self._pool.decref(p)
+        self._slot_pages[s] = []
+        self._page_table[s, :] = 0
 
     def _evict_expired(self, gen):
         """Evict cancelled/deadline-expired slots at step granularity: flush
@@ -851,6 +1163,33 @@ class ContinuousBatchingEngine:
                 req = self._pop_request()
                 if req is None:
                     break
+                if self.paged:
+                    # prefix-aware admission: pages a cache hit will map by
+                    # incref cost no fresh allocation, so only the unshared
+                    # remainder counts against headroom — this is what lets
+                    # shared-prefix traffic pack >|dense slots| concurrent
+                    # sequences into the same page budget.  Safe to check
+                    # here and act in _prefill_into_paged: this scheduler
+                    # thread is the only inserter/evictor, so the match
+                    # cannot shrink in between.  Matched pages are excluded
+                    # from the evictable count — they are about to be pinned.
+                    need = self._pages_for(req.prompt.size, req.max_new_tokens)
+                    exclude = ()
+                    if self._prefix is not None:
+                        m, fulls, tail, _rows = self._prefix.lookup(req.prompt)
+                        if m >= self.min_prefix_match:
+                            need -= len(fulls)
+                            exclude = set(fulls)
+                            if tail is not None:
+                                exclude.add(tail)
+                    if need > self._page_fresh_headroom_locked(exclude):
+                        # page pressure: park the request at the head of the
+                        # line (FIFO preserved) until draining slots release
+                        # enough pages — submit guaranteed need <= pool, so
+                        # progress is certain
+                        self._requeue.insert(0, req)
+                        self._queued_new_tokens += req.max_new_tokens
+                        break
                 self._admitting = req
                 req.state = "prefilling"
             try:
@@ -864,6 +1203,11 @@ class ContinuousBatchingEngine:
                     if self._slot_req[s] is req:
                         self._finish(s, req, "error")
                     else:
+                        if self.paged and gen == self._gen:
+                            # the prefill died after mapping pages but before
+                            # the slot landed — unmap them (a restart raced
+                            # ahead releases them itself)
+                            self._release_slot_pages_locked(s)
                         self._resolve(req, "error")
             finally:
                 with self._mu:
@@ -872,6 +1216,8 @@ class ContinuousBatchingEngine:
         return emitted
 
     def _prefill_into(self, s, req, gen):
+        if self.paged:
+            return self._prefill_into_paged(s, req, gen)
         from .. import to_tensor
 
         with self._mu:
@@ -914,6 +1260,124 @@ class ContinuousBatchingEngine:
             self._dev = None  # membership changed: rebuild device loop state
             self._emit(s, req, tok)
 
+    def _prefill_into_paged(self, s, req, gen):
+        """Paged admission: prefix-cache lookup, page mapping (shared fulls
+        read-only, COW for a matched partial page, fresh pages for the
+        rest), then either a fresh bucketed prefill or a chunk prefill of
+        just the unshared suffix — dispatched outside the mutex like the
+        dense path.  Commits the prompt's pages to the prefix cache after
+        the prefill lands."""
+        from .. import profiler as _prof
+        from .. import to_tensor
+
+        ps = self.page_size
+        L = int(req.prompt.size)
+        pinned = None  # COW source, kept alive across our own allocations
+        with self._mu:
+            self._check_gen(gen)
+            self._flush_pending_locked()
+            key = self._key
+            req.max_new_tokens = min(req.max_new_tokens, self.max_len - L)
+            coverage = self._pages_for(L, req.max_new_tokens)
+            match_len, shared_full, tail_page, tail_rows = 0, [], None, 0
+            if self._prefix is not None:
+                m, fp, tp, tr = self._prefix.lookup(req.prompt)
+                if m >= self.min_prefix_match:
+                    match_len, shared_full, tail_page, tail_rows = m, fp, tp, tr
+                else:
+                    tp = None
+                if tp is not None and tr > 0:
+                    # pin the COW source: allocating fresh pages below may
+                    # evict cache entries, and the source must survive until
+                    # the copy lands
+                    self._pool.incref(tp)
+                    pinned = tp
+            pages = []
+            try:
+                for p in shared_full:
+                    self._pool.incref(p)
+                    pages.append(p)
+                for _ in range(len(shared_full), coverage):
+                    pages.append(self._alloc_page_locked())
+            except RuntimeError:
+                if match_len == 0:
+                    raise
+                # rare corner (tiny pools): the COW pin itself kept the last
+                # evictable page alive.  Fall back to a fresh prefill — the
+                # admission headroom check guarantees full coverage without
+                # any sharing.
+                for p in pages:
+                    self._pool.decref(p)
+                if pinned is not None:
+                    self._pool.decref(pinned)
+                    pinned = None
+                match_len, shared_full, tail_page, tail_rows = 0, [], None, 0
+                pages = [self._alloc_page_locked() for _ in range(coverage)]
+            copy_args = None
+            if match_len and tail_rows > 0:
+                copy_args = (tail_page, pages[len(shared_full)])
+            self._page_table[s, :] = 0
+            self._page_table[s, : len(pages)] = pages
+            self._slot_pages[s] = list(pages)
+            _prof.record_prefix_lookup(
+                match_len > 0, tokens_saved=match_len,
+                cow_copies=1 if copy_args else 0,
+            )
+            row_table = self._page_table[s].copy()
+        suffix = L - match_len
+        bucket = self._bucket_for(suffix)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :suffix] = req.prompt[match_len:]
+        try:
+            # dispatch OUTSIDE the mutex (same contract as the dense path):
+            # the armed region must not block submitters or a restart
+            with self._watchdog.arm(
+                "serve.prefill", timeout=self._wd_timeout(),
+                context=f"req {req.id}",
+            ):
+                _inj.inject_hang("serve.prefill.hang", context=f"req {req.id}")
+                # a restart during the hang owns this request (and released
+                # the pages we just mapped) — bail before writing the arena
+                self._check_gen(gen)
+                if copy_args is not None:
+                    self._copy_fn(
+                        to_tensor(np.int32(copy_args[0])),
+                        to_tensor(np.int32(copy_args[1])),
+                    )
+                if match_len == 0:
+                    nxt, key = self._prefill_fn(
+                        to_tensor(toks), to_tensor(row_table),
+                        to_tensor(np.int32(L)),
+                        to_tensor(np.float32(req.temperature)), key,
+                    )
+                else:
+                    nxt, key = self._chunk_fn(
+                        to_tensor(toks), to_tensor(row_table),
+                        to_tensor(np.int32(suffix)),
+                        to_tensor(np.full(1, match_len, np.int32)),
+                        to_tensor(np.float32(req.temperature)), key,
+                    )
+                tok = int(np.asarray(nxt.numpy()).reshape(-1)[0])
+        finally:
+            if pinned is not None:
+                with self._mu:
+                    self._pool.decref(pinned)
+        with self._mu:
+            self._check_gen(gen)  # a restart while we dispatched owns req now
+            self._key = key
+            if self._prefix is not None:
+                inserted = self._prefix.commit(req.prompt, pages, self._pool)
+                if inserted:
+                    _prof.record_paging_event("cache_commits", inserted)
+            req.ttft_s = time.perf_counter() - req._submit_t
+            self._slot_req[s] = req
+            self._pos[s] = L
+            self._last_tok[s] = tok
+            self._temps[s] = req.temperature
+            req.state = "decoding"
+            self._dev = None  # membership changed: rebuild device loop state
+            self._emit(s, req, tok)
+
     def _decode_once(self, gen):
         from .. import profiler as _prof
         from .. import to_tensor
@@ -932,6 +1396,11 @@ class ContinuousBatchingEngine:
                     to_tensor(self._pos.copy()), to_tensor(active),
                     to_tensor(self._temps.copy()),
                 )
+                if self.paged:
+                    # page tables change exactly when membership does — the
+                    # same events that invalidate _dev — so one H2D mirror
+                    # per membership change covers every following step
+                    self._tables_t = to_tensor(self._page_table.copy())
             toks_t, pos_t, active_t, temps_t = self._dev
             key = self._key
             poison_t, poisoned = self._poison_zero, None
@@ -944,9 +1413,15 @@ class ContinuousBatchingEngine:
             "serve.decode", timeout=self._wd_timeout(),
             context=f"{len(active_idx)} active slots",
         ):
-            nxt, new_pos, finite, key = self._decode_fn(
-                toks_t, pos_t, active_t, temps_t, poison_t, key
-            )
+            if self.paged:
+                nxt, new_pos, finite, key = self._decode_fn(
+                    toks_t, pos_t, active_t, temps_t, poison_t, key,
+                    self._tables_t,
+                )
+            else:
+                nxt, new_pos, finite, key = self._decode_fn(
+                    toks_t, pos_t, active_t, temps_t, poison_t, key
+                )
         with self._mu:
             self._check_gen(gen)
             self._key = key
@@ -973,6 +1448,10 @@ class ContinuousBatchingEngine:
                 len(active_idx) / self.slots, self._queue.qsize(),
                 time.perf_counter() - t0,
             )
+            if self.paged:
+                _prof.record_paging_tick(
+                    self._pool.used_count(), self._pool.usable_pages
+                )
         return len(active_idx)
 
     def _flush_pending_locked(self):
@@ -1048,6 +1527,10 @@ class ContinuousBatchingEngine:
         self._pos[s] = 0
         self._last_tok[s] = 0
         self._temps[s] = 0.0
+        if self.paged:
+            # mappings drop; committed prefix pages live on through the
+            # cache's own hold, everything else returns to the free list
+            self._release_slot_pages_locked(s)
         self._dev = None  # membership changed: rebuild device loop state
         self._resolve(req, reason)
 
@@ -1116,3 +1599,69 @@ class ContinuousBatchingEngine:
                     "slot invariant: queued-token accounting went negative "
                     f"({self._queued_new_tokens})"
                 )
+            if self.paged:
+                self._check_page_invariants_locked()
+
+    def _check_page_invariants_locked(self):
+        """FLAGS_serve_debug_invariants, paged extension: every page's
+        refcount equals its observable holds (slot mappings + prefix-cache
+        entries), the free list is exactly the ref-0 pages, free slots map
+        nothing, and an occupied slot's table covers every position it has
+        written.  Caller holds _mu."""
+        pool, ps = self._pool, self.page_size
+        expected = np.zeros(pool.num_pages, np.int64)
+        expected[0] = 1  # scratch pin
+        for s in range(self.slots):
+            row = self._page_table[s]
+            mapped = self._slot_pages[s]
+            if self._slot_req[s] is None:
+                if mapped or row.any():
+                    raise AssertionError(
+                        f"page invariant: free slot {s} still maps pages "
+                        f"{mapped} (table row {row.tolist()})"
+                    )
+                continue
+            if len(set(mapped)) != len(mapped) or 0 in mapped:
+                raise AssertionError(
+                    f"page invariant: slot {s} mapping {mapped} has "
+                    "duplicates or scratch"
+                )
+            nz = [int(p) for p in row if p]
+            if nz != list(mapped):
+                raise AssertionError(
+                    f"page invariant: slot {s} table row {row.tolist()} "
+                    f"disagrees with its mapping {mapped}"
+                )
+            frontier = (int(self._pos[s]) - 1) // ps
+            if frontier >= len(mapped):
+                raise AssertionError(
+                    f"page invariant: slot {s} at pos {int(self._pos[s])} "
+                    f"writes page entry {frontier} but maps only "
+                    f"{len(mapped)} pages"
+                )
+            for p in mapped:
+                expected[p] += 1
+        if self._prefix is not None:
+            for e in self._prefix.entries():
+                if not 0 < e.rows <= ps:
+                    raise AssertionError(
+                        f"page invariant: cache entry on page {e.page} has "
+                        f"row count {e.rows} outside (0, {ps}]"
+                    )
+                expected[e.page] += 1
+        if not np.array_equal(expected, pool.refs):
+            bad = [
+                (p, int(pool.refs[p]), int(expected[p]))
+                for p in range(pool.num_pages)
+                if pool.refs[p] != expected[p]
+            ]
+            raise AssertionError(
+                "page invariant: refcount drift (page, actual, expected): "
+                f"{bad}"
+            )
+        free = sorted(pool._free)
+        ref0 = [p for p in range(1, pool.num_pages) if pool.refs[p] == 0]
+        if free != ref0 or len(set(free)) != len(free):
+            raise AssertionError(
+                f"page invariant: free list {free} != ref-0 pages {ref0}"
+            )

@@ -1,0 +1,248 @@
+"""Host-side bookkeeping for the block-paged KV cache (ISSUE 7).
+
+The device side is dumb on purpose: per layer, one `[num_pages, page_size,
+kv_heads, head_dim]` K/V arena plus per-slot page tables carried as traced
+DATA through the compiled decode/prefill steps (engine.py).  Everything that
+decides WHICH page holds WHICH tokens lives here, on the host, where it can
+be mutated without recompiles:
+
+- `PagePool` — refcounted free-list allocator over page ids.  Page 0 is a
+  permanent scratch page: inactive slots' table rows are all-zero and every
+  masked/out-of-range scatter is redirected to it, so garbage writes can
+  never land in a page another sequence attends.
+- `PrefixCache` — a token-chain index over COMMITTED prompt pages.  Full
+  pages chain by `(parent_key, page_tokens)`; a partially filled last page
+  is stored as a tail under its parent.  A new request walks the chain,
+  maps every matched full page read-only (incref), and copy-on-writes the
+  matched tail (the only shared page it would ever append into).  Entries
+  are evicted LRU, leaves first, only when the allocator runs dry — the
+  cache is a use for pages that would otherwise sit on the free list.
+
+Sharing safety contract (relied on by the engine and the COW tests):
+
+- readers of a cached page trust only rows < the entry's committed row
+  count; everything beyond is masked by position, so the OWNER may keep
+  appending into its own committed tail without invalidating readers;
+- a reader never writes a shared page: full-page matches are read-only by
+  construction (its own rows start after them) and the tail match is copied
+  into a fresh page at admission, before any token lands.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class PagePool:
+    """Refcounted page allocator.  Page 0 is scratch: pinned, never handed
+    out, the target of every redirected garbage write."""
+
+    def __init__(self, num_pages):
+        if num_pages < 2:
+            raise ValueError("page pool needs >= 2 pages (1 scratch + 1 usable)")
+        self.num_pages = int(num_pages)
+        self.refs = np.zeros(self.num_pages, np.int64)
+        self.refs[0] = 1  # scratch, pinned forever
+        self._free = list(range(1, self.num_pages))
+
+    @property
+    def usable_pages(self):
+        return self.num_pages - 1
+
+    def free_count(self):
+        return len(self._free)
+
+    def used_count(self):
+        return self.usable_pages - len(self._free)
+
+    def alloc(self):
+        """One page at refcount 1; the caller must have checked free_count
+        (the engine's admission math guarantees it never runs dry)."""
+        if not self._free:
+            raise RuntimeError(
+                "page pool exhausted — admission reservations should have "
+                "prevented this allocation (accounting bug)"
+            )
+        p = self._free.pop(0)
+        assert self.refs[p] == 0, f"free-list page {p} had refcount {self.refs[p]}"
+        self.refs[p] = 1
+        return p
+
+    def incref(self, page):
+        assert page != 0, "scratch page is never mapped"
+        assert self.refs[page] > 0, f"incref on dead page {page}"
+        self.refs[page] += 1
+
+    def decref(self, page):
+        """Drop one reference; a page hitting 0 returns to the free list."""
+        assert page != 0, "scratch page is never released"
+        assert self.refs[page] > 0, f"decref on dead page {page}"
+        self.refs[page] -= 1
+        if self.refs[page] == 0:
+            self._free.append(page)
+            return True
+        return False
+
+
+class _Entry:
+    __slots__ = ("key", "parent_key", "page", "rows", "children", "last_used", "tokens")
+
+    def __init__(self, key, parent_key, page, rows, tokens):
+        self.key = key
+        self.parent_key = parent_key
+        self.page = int(page)
+        self.rows = int(rows)  # committed rows; readers trust only j < rows
+        self.children = 0
+        self.last_used = 0
+        self.tokens = tokens  # the page's committed token ids (tuple)
+
+
+class PrefixCache:
+    """Token-chain index over committed prompt pages.
+
+    Full pages are keyed `(parent_key, page_tokens)` so equal prefixes
+    converge on the same chain regardless of which request committed them;
+    partial last pages are stored as tails under their parent and matched by
+    longest common prefix.  Eviction is LRU over childless entries only — a
+    parent outlives its children, so no chain ever dangles.
+    """
+
+    _ROOT = ()
+
+    def __init__(self, page_size):
+        self.page_size = int(page_size)
+        self._full = {}   # key -> _Entry (rows == page_size)
+        self._tails = {}  # parent_key -> [ _Entry ] (rows < page_size)
+        self._clock = 0
+
+    def __len__(self):
+        return len(self._full) + sum(len(v) for v in self._tails.values())
+
+    def entries(self):
+        for e in self._full.values():
+            yield e
+        for tails in self._tails.values():
+            yield from tails
+
+    def _tick(self, entry):
+        self._clock += 1
+        entry.last_used = self._clock
+
+    def lookup(self, prompt):
+        """Longest cached prefix of `prompt` (np.int32 [L]), capped at L-1 so
+        at least one suffix token remains to prefill and sample from.
+        Returns (match_len, full_pages, tail_page, tail_rows): `full_pages`
+        are read-only mappable as-is, the tail page (if any) must be
+        copy-on-written before the reader appends.  Bumps LRU on the matched
+        chain; refcounts are the caller's job (it holds the pool)."""
+        ps = self.page_size
+        L = int(prompt.size)
+        toks = prompt.tolist()
+        key = self._ROOT
+        full_pages = []
+        matched = []
+        i = 0
+        while i + ps <= L - 1:  # a full-page match must leave >= 1 suffix token
+            child = self._full.get((key, tuple(toks[i : i + ps])))
+            if child is None:
+                break
+            full_pages.append(child.page)
+            matched.append(child)
+            key = child.key
+            i += ps
+        tail_page, tail_rows = None, 0
+        best = None
+        for e in self._tails.get(key, ()):
+            lcp = 0
+            for a, b in zip(e.tokens, toks[i : L - 1]):  # cap total match at L-1
+                if a != b:
+                    break
+                lcp += 1
+            if lcp > tail_rows:
+                tail_rows, tail_page, best = lcp, e.page, e
+        if best is not None:
+            matched.append(best)
+        for e in matched:
+            self._tick(e)
+        return i + tail_rows, full_pages, tail_page, tail_rows
+
+    def commit(self, prompt, pages, pool):
+        """Insert-if-absent the prompt's pages after its prefill completed:
+        one full-page entry per complete page, one tail for the remainder.
+        New entries incref their page (the cache's own hold); pages whose
+        chain position is already cached are left alone — the committer may
+        have mapped that very entry's page at admission."""
+        ps = self.page_size
+        L = int(prompt.size)
+        toks = prompt.tolist()
+        key = self._ROOT
+        inserted = 0
+        for i in range(L // ps):
+            ek = (key, tuple(toks[i * ps : (i + 1) * ps]))
+            e = self._full.get(ek)
+            if e is None:
+                e = _Entry(ek, key, pages[i], ps, ek[1])
+                self._full[ek] = e
+                pool.incref(e.page)
+                parent = self._full.get(key) if key is not self._ROOT else None
+                if parent is not None:
+                    parent.children += 1
+                inserted += 1
+            self._tick(e)
+            key = e.key
+        rows = L % ps
+        if rows:
+            tokens = tuple(toks[L - rows : L])
+            tails = self._tails.setdefault(key, [])
+            for e in tails:
+                if e.tokens == tokens:
+                    self._tick(e)
+                    return inserted
+            e = _Entry((key, tokens), key, pages[L // ps], rows, tokens)
+            tails.append(e)
+            pool.incref(e.page)
+            parent = self._full.get(key) if key is not self._ROOT else None
+            if parent is not None:
+                parent.children += 1
+            self._tick(e)
+            inserted += 1
+        return inserted
+
+    def _remove(self, entry):
+        if entry.rows == self.page_size:
+            del self._full[entry.key]
+            self._tails.pop(entry.key, None)  # only ever empty lists by now
+        else:
+            tails = self._tails.get(entry.parent_key, [])
+            tails.remove(entry)
+            if not tails:
+                self._tails.pop(entry.parent_key, None)
+        parent = self._full.get(entry.parent_key) if entry.parent_key else None
+        if parent is not None:
+            parent.children -= 1
+
+    def evict_one(self, pool):
+        """Drop the LRU childless entry and release its page hold.  Returns
+        the evicted entry or None when the cache is empty.  The freed page
+        only reaches the free list if no live slot still maps it — eviction
+        never invalidates a reader."""
+        victim = None
+        for e in self.entries():
+            if e.rows == self.page_size and (
+                e.children > 0 or self._tails.get(e.key)
+            ):
+                continue  # a parent outlives its children
+            if victim is None or e.last_used < victim.last_used:
+                victim = e
+        if victim is None:
+            return None
+        self._remove(victim)
+        pool.decref(victim.page)
+        return victim
+
+    def clear(self, pool):
+        """Release every cache hold (engine shutdown / tests)."""
+        n = 0
+        while self.evict_one(pool) is not None:
+            n += 1
+        return n

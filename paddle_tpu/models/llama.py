@@ -203,6 +203,100 @@ def _slot_write(pool_t, new_t, slot_t):
     return apply(f, [pool_t, new_t, slot_t], name="kv_slot_write")
 
 
+class PagedKVCache:
+    """One layer's paged K/V arena: `[num_pages, page_size, kv_heads,
+    head_dim]` buffers addressed through per-slot page tables (traced data).
+    Page 0 is scratch — inactive slots' all-zero table rows and every
+    masked scatter land there (see inference/paging.py)."""
+
+    def __init__(self, num_pages, page_size, kv_heads, head_dim, dtype="float32"):
+        from ..framework import core as _fcore
+
+        self.page_size = int(page_size)
+        zeros = np.zeros(
+            (num_pages, page_size, kv_heads, head_dim), _fcore.to_jax_dtype(dtype)
+        )
+        self.k = Tensor(zeros)
+        self.v = Tensor(zeros.copy())
+        self.k.stop_gradient = True
+        self.v.stop_gradient = True
+
+
+class PagedPrefillView:
+    """Prefill into a paged arena.  Fresh prefill (`start is None`): the
+    prompt attends to itself causally — the exact SlotView math, so paged
+    and dense engines stay bit-identical — while its K/V scatter into the
+    pages of `table` ([max_pages_per_seq] int32, data).  Chunk prefill
+    (`start` an int32[1] Tensor): a prefix-cache hit prefills only the
+    unshared suffix at rope offset `start`, attending the shared pages
+    through a table gather.  Rows past `true_len` (bucket padding) and rows
+    whose page index overruns the table are redirected to scratch page 0."""
+
+    def __init__(self, arena, table, true_len, max_len, start=None):
+        self.arena = arena
+        self.table = table
+        self.true_len = true_len
+        self.max_len = max_len
+        self.start = start
+
+
+class PagedDecodeView:
+    """Compiled decode over the paged arena: `tables` is the full
+    [slots, max_pages_per_seq] int32 page table (data), each slot writes
+    its token at page `tables[s, pos//page_size]` row `pos % page_size`
+    and attends the gathered pages sliced back to [slots, max_len] — the
+    same attended geometry as the dense slot pool, bit for bit."""
+
+    def __init__(self, arena, tables, max_len):
+        self.arena = arena
+        self.tables = tables
+        self.max_len = max_len
+
+
+def _page_scatter(arena_t, new_t, table_t, true_len_t, start_t=None):
+    """Scatter a [1, s, kv_heads, d] prefill chunk into pages: row i lands
+    at global index start+i -> (table[idx // page_size], idx % page_size).
+    Rows with i >= true_len (bucket padding) or a page index beyond the
+    table are redirected to scratch page 0 — padding garbage never touches
+    a page a reader could share."""
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import apply
+
+    ps = arena_t.shape[1]
+
+    def f(c, n, t, tl, *st):
+        s = n.shape[1]
+        i = jnp.arange(s, dtype=jnp.int32)
+        idx = (st[0][0] + i) if st else i
+        entry = idx // ps
+        P = t.shape[0]
+        valid = (i < tl) & (entry < P)
+        pg = jnp.where(valid, t[jnp.minimum(entry, P - 1)], 0)
+        return c.at[pg, idx % ps].set(n[0].astype(c.dtype))
+
+    ins = [arena_t, new_t, table_t, true_len_t] + ([start_t] if start_t is not None else [])
+    return apply(f, ins, name="kv_page_scatter")
+
+
+def _page_decode_write(arena_t, new_t, tables_t, pos_t):
+    """Per-slot decode write: slot s's [1, kv_heads, d] token K/V lands at
+    page tables[s, pos[s]//page_size] row pos[s] % page_size.  Inactive
+    slots run at pos 0 over an all-zero table row — scratch page 0."""
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import apply
+
+    ps = arena_t.shape[1]
+
+    def f(c, n, t, p):
+        entry = p // ps  # [slots]; pos < pages*ps by the admission math
+        pg = jnp.take_along_axis(t, entry[:, None], axis=1)[:, 0]
+        return c.at[pg, p % ps].set(n[:, 0].astype(c.dtype))
+
+    return apply(f, [arena_t, new_t, tables_t, pos_t], name="kv_page_decode_write")
+
+
 class LlamaMLP(nn.Layer):
     def __init__(self, config):
         super().__init__()
@@ -246,6 +340,56 @@ class LlamaAttention(nn.Layer):
         q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
         k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        if isinstance(cache, PagedPrefillView):
+            if cache.start is None:
+                # fresh paged prefill: identical math to the dense SlotView
+                # path (rope offset 0, causal SDPA over the prompt) — only
+                # WHERE the K/V rows land differs, so paged and dense
+                # engines produce bit-identical tokens
+                q, k = apply_rotary_pos_emb(q, k, self.rope_cos, self.rope_sin, 0)
+                cache.arena.k._data = _page_scatter(
+                    cache.arena.k, k, cache.table, cache.true_len
+                )._data
+                cache.arena.v._data = _page_scatter(
+                    cache.arena.v, v, cache.table, cache.true_len
+                )._data
+                out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+            else:
+                # chunk prefill (prefix-cache hit): suffix rows at rope
+                # offset `start` scatter into their pages, then attend the
+                # whole sequence — shared prefix included — through the
+                # table gather; row i sees j <= start + i
+                q, k = apply_rotary_pos_emb(
+                    q, k, self.rope_cos, self.rope_sin, cache.start
+                )
+                cache.arena.k._data = _page_scatter(
+                    cache.arena.k, k, cache.table, cache.true_len, cache.start
+                )._data
+                cache.arena.v._data = _page_scatter(
+                    cache.arena.v, v, cache.table, cache.true_len, cache.start
+                )._data
+                out = F.paged_flash_decode(
+                    q, cache.arena.k, cache.arena.v,
+                    cache.table.reshape([1, -1]), cache.start, cache.max_len,
+                )
+            out = out.reshape([b, s, self.num_heads * self.head_dim])
+            return self.o_proj(out), cache
+        if isinstance(cache, PagedDecodeView):
+            # paged compiled decode: same per-row rope and attended geometry
+            # as the dense StaticKVCache path; the gather through the page
+            # table happens inside the compiled step (tables are data)
+            q, k = apply_rotary_pos_emb(q, k, self.rope_cos, self.rope_sin, pos)
+            cache.arena.k._data = _page_decode_write(
+                cache.arena.k, k, cache.tables, pos
+            )._data
+            cache.arena.v._data = _page_decode_write(
+                cache.arena.v, v, cache.tables, pos
+            )._data
+            out = F.paged_flash_decode(
+                q, cache.arena.k, cache.arena.v, cache.tables, pos, cache.max_len
+            )
+            out = out.reshape([b, s, self.num_heads * self.head_dim])
+            return self.o_proj(out), cache
         if isinstance(cache, SlotView):
             # compiled prefill into a pooled cache: the prompt attends to
             # itself (plain causal attention) while its K/V are written into

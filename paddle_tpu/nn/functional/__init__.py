@@ -1356,6 +1356,17 @@ def flash_decode(query, key, value, pos, scale=None):
     return _fd(query, key, value, pos, scale)
 
 
+def paged_flash_decode(query, arena_k, arena_v, tables, pos, max_len, scale=None):
+    """Cached attention over a block-paged KV pool: q [b, sq, h, d] against
+    per-layer arenas [num_pages, page_size, kv_h, d], addressed through
+    `tables` ([b, max_pages_per_seq] int32, traced data).  The page gather
+    happens inside the compiled step; validity comes from `pos` exactly as
+    in flash_decode, so paged and dense decode are bit-identical."""
+    from ...ops.flash_attention import paged_flash_decode as _pfd
+
+    return _pfd(query, arena_k, arena_v, tables, pos, max_len, scale)
+
+
 # ---------------------------------------------------------------------------
 # misc
 # ---------------------------------------------------------------------------

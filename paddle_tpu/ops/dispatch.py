@@ -144,6 +144,13 @@ def _code_key(fn, depth=0):
 
 
 _last_salt_mesh = None
+# memoized module refs: _dispatch_salt runs on EVERY eager op, and the
+# per-call `import` statements + sys.modules lookups it used to do were
+# measurable at lenet_eager scale (~30k ops/s); modules never unload, so
+# one resolution is enough (flash_attention may not be imported yet —
+# retry the lookup only while unresolved)
+_mesh_mod = None
+_fa_mod = None
 
 
 def _dispatch_salt():
@@ -151,10 +158,12 @@ def _dispatch_salt():
     A mesh change clears the whole cache — entries keyed on a dead mesh
     could never hit again and would strand compiled executables (same
     staleness class as the GPT pipe-cache advisor finding)."""
-    global _last_salt_mesh
-    from ..distributed import mesh as _mesh
+    global _last_salt_mesh, _mesh_mod, _fa_mod
+    if _mesh_mod is None:
+        from ..distributed import mesh as _mesh_mod_
 
-    mesh = _mesh.get_mesh()
+        _mesh_mod = _mesh_mod_
+    mesh = _mesh_mod.get_mesh()
     if mesh is not _last_salt_mesh:
         _EAGER_STATS["invalidations"] += len(_EAGER_CACHE)
         _EAGER_CACHE.clear()
@@ -165,10 +174,11 @@ def _dispatch_salt():
     # without them a flag flip after a same-shape call would silently return
     # the stale cached executable (e.g. a test forcing the Pallas interpret
     # path getting the previously-compiled XLA path)
-    import sys
+    if _fa_mod is None:
+        import sys
 
-    fa = sys.modules.get("paddle_tpu.ops.flash_attention")
-    fa_key = getattr(fa, "_FORCE_INTERPRET", None) if fa is not None else None
+        _fa_mod = sys.modules.get("paddle_tpu.ops.flash_attention")
+    fa_key = getattr(_fa_mod, "_FORCE_INTERPRET", None)
     return (mesh, amp_key, _core.flag("FLAGS_check_nan_inf"), fa_key)
 
 

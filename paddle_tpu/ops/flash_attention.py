@@ -737,6 +737,41 @@ def flash_decode(query, key, value, pos, scale=None):
     return apply(f, [query, key, value, pos], name="flash_decode")
 
 
+def paged_gather_kv(arena, tables, max_len):
+    """Gather a paged arena [num_pages, page_size, kv_h, d] back into dense
+    per-sequence buffers [b, max_len, kv_h, d] through the page tables
+    ([b, P] int32).  The reshape-then-slice keeps the attended geometry
+    identical to the dense slot pool (P * page_size >= max_len; the slack
+    rows come from the sequence's own trailing page and are masked by pos
+    downstream anyway)."""
+    b = tables.shape[0]
+    g = arena[tables]  # [b, P, page_size, kv_h, d]
+    g = g.reshape(b, -1, arena.shape[2], arena.shape[3])
+    return g[:, :max_len]
+
+
+def paged_decode_attention_array(q, arena_k, arena_v, tables, pos, max_len, scale=None):
+    """decode_attention_array over a block-paged KV pool: gather each
+    sequence's pages via its table row (inside the compiled step — tables
+    are data), then run the exact dense-cache decode math on the result.
+    Bit-identical to the dense path given bit-identical cache rows."""
+    k = paged_gather_kv(arena_k, tables, max_len)
+    v = paged_gather_kv(arena_v, tables, max_len)
+    return decode_attention_array(q, k, v, pos, scale)
+
+
+def paged_flash_decode(query, arena_k, arena_v, tables, pos, max_len, scale=None):
+    """Tensor-level paged cached-decode attention."""
+    query, arena_k, arena_v = coerce(query), coerce(arena_k), coerce(arena_v)
+    tables, pos = coerce(tables), coerce(pos)
+    max_len = int(max_len)
+
+    def f(q, ak, av, t, p):
+        return paged_decode_attention_array(q, ak, av, t, p, max_len, scale)
+
+    return apply(f, [query, arena_k, arena_v, tables, pos], name="paged_flash_decode")
+
+
 # ---------------------------------------------------------------------------
 # Blockwise XLA fallback (O(seq) memory via scan + checkpoint)
 # ---------------------------------------------------------------------------
@@ -860,21 +895,28 @@ def _flash_backward(q, k, v, mask, out, lse, g, causal, scale, block_k=512):
 # public entry — jax-level (arrays in, arrays out; custom_vjp around pallas)
 # ---------------------------------------------------------------------------
 
-_fallback_logged = False
+_fallback_logged = set()  # (reason, shape) pairs already warned about
 
 
-def _log_pallas_fallback(reason):
+def _log_pallas_fallback(reason, shape=None):
     """Gate honesty (round-1 finding): never silently run the slow path on a
-    TPU — benches must be able to see which kernel they measured."""
-    global _fallback_logged
-    if not _fallback_logged:
+    TPU — benches must be able to see which kernel they measured.  Counts
+    every fallback into the profiler's `flash_fallbacks` gauge and warns
+    once per (reason, q-shape) so a new shape hitting the slow path is
+    visible even late in a long run."""
+    from .. import profiler as _prof
+
+    _prof.record_flash_fallback(reason)
+    key = (reason, tuple(shape) if shape is not None else None)
+    if key not in _fallback_logged:
         import logging
 
         logging.getLogger("paddle_tpu").warning(
-            "flash_attention: Pallas kernel unavailable (%s); using XLA blockwise fallback",
-            reason,
+            "flash_attention: Pallas kernel unavailable (%s) for q shape %s; "
+            "using XLA blockwise fallback",
+            reason, key[1],
         )
-        _fallback_logged = True
+        _fallback_logged.add(key)
 
 
 # tests set this to exercise the Pallas kernels off-TPU via interpret mode
@@ -927,7 +969,7 @@ def _flash_fwd_impl(q, k, v, mask, segments, causal, scale):
                 interpret=interpret,
             )
             return out.reshape(b, h, s, d), lse.reshape(b, h, s), True
-        _log_pallas_fallback(reason)
+        _log_pallas_fallback(reason, shape=q.shape)
     if segments is not None:
         seg_mask = _segments_mask(segments, b, h)
         mask = seg_mask if mask is None else mask + seg_mask

@@ -129,6 +129,7 @@ _serving_gauges = {
     "busy_s": 0.0,
     "ticks": 0,
     "occupancy_sum": 0.0,
+    "occupancy_peak": 0.0,
     "queue_depth_sum": 0,
     "queue_depth_max": 0,
     "faults": {},  # serving fault-domain counters, by kind
@@ -167,6 +168,8 @@ def record_serving_tick(occupancy, queue_depth, busy_s=0.0):
     g = _serving_gauges
     g["ticks"] += 1
     g["occupancy_sum"] += float(occupancy)
+    if occupancy > g["occupancy_peak"]:
+        g["occupancy_peak"] = float(occupancy)
     g["queue_depth_sum"] += int(queue_depth)
     g["busy_s"] += float(busy_s)
     if queue_depth > g["queue_depth_max"]:
@@ -177,8 +180,107 @@ def reset_serving():
     g = _serving_gauges
     g.update(
         requests=0, tokens=0, ttfts_s=[], busy_s=0.0, ticks=0,
-        occupancy_sum=0.0, queue_depth_sum=0, queue_depth_max=0, faults={},
+        occupancy_sum=0.0, occupancy_peak=0.0, queue_depth_sum=0,
+        queue_depth_max=0, faults={},
     )
+
+
+# ---------------------------------------------------------------------------
+# Paged-KV gauges (ISSUE 7): the paged serving engine reports admission-time
+# prefix-cache outcomes (hit/miss, prompt tokens whose prefill was skipped,
+# copy-on-write page copies) and allocator events (cache evictions, cache
+# commits), plus a per-tick page-occupancy gauge so peak arena pressure is
+# visible next to slot occupancy.  Separately, flash-attention records every
+# Pallas->XLA fallback by reason so "why is attention slow" is answerable
+# from the summary instead of from scrolling warnings.
+# ---------------------------------------------------------------------------
+
+_paging_gauges = {
+    "prefix_hits": 0,
+    "prefix_misses": 0,
+    "prefill_tokens_saved": 0,
+    "cow_copies": 0,
+    "cache_evictions": 0,
+    "cache_commits": 0,
+    "ticks": 0,
+    "pages_used_sum": 0,
+    "pages_used_peak": 0,
+    "pages_total": 0,
+}
+
+_flash_fallbacks = {}  # reason -> count of Pallas-ineligible compilations
+
+
+def record_flash_fallback(reason):
+    """One flash-attention dispatch that fell back from the Pallas kernel to
+    the XLA blockwise path; counted per compiled shape, keyed by reason."""
+    _flash_fallbacks[reason] = _flash_fallbacks.get(reason, 0) + 1
+
+
+def flash_fallback_summary():
+    return dict(_flash_fallbacks)
+
+
+def reset_flash_fallbacks():
+    _flash_fallbacks.clear()
+
+
+def record_prefix_lookup(hit, tokens_saved=0, cow_copies=0):
+    """One admission-time prefix-cache lookup: whether any cached prefix was
+    reused, how many prompt tokens skipped prefill, and how many shared
+    pages were copy-on-written for the new reader."""
+    g = _paging_gauges
+    if hit:
+        g["prefix_hits"] += 1
+        g["prefill_tokens_saved"] += int(tokens_saved)
+        g["cow_copies"] += int(cow_copies)
+    else:
+        g["prefix_misses"] += 1
+
+
+def record_paging_event(kind, n=1):
+    """Count an allocator event: 'cache_evictions' or 'cache_commits'."""
+    g = _paging_gauges
+    g[kind] = g.get(kind, 0) + int(n)
+
+
+def record_paging_tick(pages_used, pages_total):
+    """One engine step's page-pool occupancy snapshot."""
+    g = _paging_gauges
+    g["ticks"] += 1
+    g["pages_used_sum"] += int(pages_used)
+    g["pages_total"] = int(pages_total)
+    if pages_used > g["pages_used_peak"]:
+        g["pages_used_peak"] = int(pages_used)
+
+
+def reset_paging():
+    g = _paging_gauges
+    for k in g:
+        g[k] = 0
+
+
+def paging_summary():
+    """Aggregated paged-KV metrics: prefix hit rate, prefill tokens saved,
+    COW copies, cache churn, and mean/peak page occupancy."""
+    g = _paging_gauges
+    out = {}
+    lookups = g["prefix_hits"] + g["prefix_misses"]
+    if lookups:
+        out["prefix_lookups"] = lookups
+        out["prefix_hits"] = g["prefix_hits"]
+        out["prefix_hit_rate"] = g["prefix_hits"] / lookups
+        out["prefill_tokens_saved"] = g["prefill_tokens_saved"]
+        out["cow_copies"] = g["cow_copies"]
+    if g["cache_evictions"]:
+        out["cache_evictions"] = g["cache_evictions"]
+    if g["cache_commits"]:
+        out["cache_commits"] = g["cache_commits"]
+    if g["ticks"]:
+        out["pages_used_mean"] = g["pages_used_sum"] / g["ticks"]
+        out["pages_used_peak"] = g["pages_used_peak"]
+        out["pages_total"] = g["pages_total"]
+    return out
 
 
 def _pctl(sorted_vals, q):
@@ -201,6 +303,7 @@ def serving_summary():
         out["ttft_p95_ms"] = _pctl(ttfts, 0.95) * 1e3
     if g["ticks"]:
         out["occupancy_mean"] = g["occupancy_sum"] / g["ticks"]
+        out["occupancy_peak"] = g["occupancy_peak"]
         out["queue_depth_avg"] = g["queue_depth_sum"] / g["ticks"]
         out["queue_depth_max"] = g["queue_depth_max"]
     if g["faults"]:
@@ -344,6 +447,26 @@ class Profiler:
             print(
                 "serving faults: "
                 + "  ".join(f"{k} {v}" for k, v in sorted(sv["faults"].items()))
+            )
+        pg = paging_summary()
+        if pg.get("prefix_lookups"):
+            print(
+                "paged kv: hit rate {hr:.2f} ({hits}/{lk})"
+                "  tokens saved {saved}  cow copies {cow}"
+                "  pages mean {pm:.1f} peak {pp}/{pt}".format(
+                    hr=pg["prefix_hit_rate"], hits=pg["prefix_hits"],
+                    lk=pg["prefix_lookups"],
+                    saved=pg["prefill_tokens_saved"], cow=pg["cow_copies"],
+                    pm=pg.get("pages_used_mean", 0.0),
+                    pp=pg.get("pages_used_peak", 0),
+                    pt=pg.get("pages_total", 0),
+                )
+            )
+        fb = flash_fallback_summary()
+        if fb:
+            print(
+                "flash fallbacks: "
+                + "  ".join(f"{k} {v}" for k, v in sorted(fb.items()))
             )
         # compile caches dominate cold-start cost: surface them next to the
         # step timing so "why was the first step slow" is answerable here

@@ -20,6 +20,7 @@ sized to one v5e chip's HBM, not a 7B TP=8 run — labeled in the JSON.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import subprocess
@@ -55,15 +56,26 @@ def _on_tpu():
     return jax.default_backend() == "tpu"
 
 
-def throughput_gate(value, minimum, enforced, key="min_steps_per_sec"):
+def throughput_gate(value, minimum, enforced, key="min_steps_per_sec",
+                    unexpected_recompiles=None):
     """Per-config regression gate: {key: bar, enforced, ok}.  `ok` is True
     when the bar is cleared OR the gate is unenforced (CPU CI throughput is
     noise; gates bind on the TPU chip).  main() exits nonzero when any
     enforced gate fails — after printing the full matrix, so the numbers
     behind the failure are always in the output.  Kept as a plain function
-    so the gate logic itself is unit-testable without a TPU."""
+    so the gate logic itself is unit-testable without a TPU.
+
+    `unexpected_recompiles` (the runtime sanitizer's steady-state trace/
+    compile counter) is a CORRECTNESS gate, not a throughput gate: any
+    nonzero count fails the leg even where the throughput bar is
+    unenforced — a recompile in steady state is deterministic, CPU noise
+    cannot excuse it."""
     gate = {key: float(minimum), "enforced": bool(enforced)}
     gate["ok"] = bool(value >= gate[key]) or not gate["enforced"]
+    if unexpected_recompiles is not None:
+        gate["unexpected_recompiles"] = int(unexpected_recompiles)
+        gate["enforced"] = bool(gate["enforced"] or unexpected_recompiles > 0)
+        gate["ok"] = gate["ok"] and int(unexpected_recompiles) == 0
     return gate
 
 
@@ -102,6 +114,33 @@ def _cache_probe():
 def _cache_delta(before):
     after = _cache_probe()
     return {k: after[k] - before[k] for k in before}
+
+
+@contextlib.contextmanager
+def _sanitized_serving():
+    """Run a serving leg under FLAGS_debug_sanitize: the engine's steady-
+    state step zone counts every fresh trace / eager compile / host sync,
+    and the leg's gate fails on a nonzero unexpected count (the runtime
+    twin of the compile_cache delta printed next to it)."""
+    from paddle_tpu.analysis import sanitizer
+    from paddle_tpu.framework import core as fcore
+
+    fcore.set_flags({"FLAGS_debug_sanitize": True})
+    sanitizer.reset()
+    try:
+        yield sanitizer
+    finally:
+        fcore.set_flags({"FLAGS_debug_sanitize": False})
+
+
+def _sanitizer_summary(sanitizer):
+    c = sanitizer.counters()
+    return {
+        "unexpected_recompiles": c["unexpected_traces"] + c["unexpected_eager"],
+        "unexpected_syncs": c["unexpected_syncs"],
+        "steady_traces": c["traces"],
+        "allowed_events": c["allowed_events"],
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -531,16 +570,18 @@ def bench_llama_serving():
     eng.warmup()
     profiler.reset_serving()
     gaps = rng.exponential(mean_gap, size=n_req)
-    eng.start()
-    handles = []
-    t0 = time.perf_counter()
-    for i in range(n_req):
-        time.sleep(gaps[i])
-        handles.append(eng.submit(prompts[i], max_new_tokens=int(new_toks[i])))
-    for h in handles:
-        h.wait(timeout=600)
-    eng_wall = time.perf_counter() - t0
-    eng.stop()
+    with _sanitized_serving() as _san:
+        eng.start()
+        handles = []
+        t0 = time.perf_counter()
+        for i in range(n_req):
+            time.sleep(gaps[i])
+            handles.append(eng.submit(prompts[i], max_new_tokens=int(new_toks[i])))
+        for h in handles:
+            h.wait(timeout=600)
+        eng_wall = time.perf_counter() - t0
+        eng.stop()
+    san = _sanitizer_summary(_san)
     eng_tok_s = total_tokens / eng_wall
     s = profiler.serving_summary()
 
@@ -576,6 +617,11 @@ def bench_llama_serving():
         "slots": slots,
         "mixed_new_tokens": [int(lo), int(hi)],
         "compiles": eng.compile_counts(),
+        "sanitizer": san,
+        "gate": throughput_gate(
+            eng_tok_s / base_tok_s, 1.5, on_tpu, key="min_serving_speedup",
+            unexpected_recompiles=san["unexpected_recompiles"],
+        ),
         "note": "Poisson arrivals, log-uniform request lengths; slot-pooled "
         "continuous batching vs lock-step batches of `slots` (each row pays "
         "its batch's max length); tokens/s counts requested tokens only",
@@ -654,7 +700,7 @@ def bench_paged_serving():
         eng.warmup()
         profiler.reset_serving()
         profiler.reset_paging()
-        eng.start()
+        eng.start()  # called inside _sanitized_serving() by the driver below
         handles = []
         t0 = time.perf_counter()
         for i in range(n_req):
@@ -690,15 +736,17 @@ def bench_paged_serving():
         model, slots=dense_slots, max_len=max_len,
         prefill_buckets=[prompt_len], queue_depth=n_req, seed=0, paged=False,
     )
-    d_wall, d_sv, _, d_handles, d_probes = _run(dense_eng)
+    with _sanitized_serving() as _san:
+        d_wall, d_sv, _, d_handles, d_probes = _run(dense_eng)
 
-    paged_eng = ContinuousBatchingEngine(
-        model, slots=2 * dense_slots, max_len=max_len,
-        prefill_buckets=[sfx, prompt_len], queue_depth=n_req, seed=0,
-        paged=True, page_size=page_size, pool_pages=pool_pages,
-        prefix_cache=True,
-    )
-    p_wall, p_sv, p_pg, p_handles, p_probes = _run(paged_eng)
+        paged_eng = ContinuousBatchingEngine(
+            model, slots=2 * dense_slots, max_len=max_len,
+            prefill_buckets=[sfx, prompt_len], queue_depth=n_req, seed=0,
+            paged=True, page_size=page_size, pool_pages=pool_pages,
+            prefix_cache=True,
+        )
+        p_wall, p_sv, p_pg, p_handles, p_probes = _run(paged_eng)
+    san = _sanitizer_summary(_san)
 
     d_tok = sum(len(h.tokens) for h in d_handles)
     p_tok = sum(len(h.tokens) for h in p_handles)
@@ -708,13 +756,18 @@ def bench_paged_serving():
     d_shared_p50 = d_probes[len(d_probes) // 2] if d_probes else 0.0
     p_shared_p50 = p_probes[len(p_probes) // 2] if p_probes else 0.0
     reduction = 1.0 - p_shared_p50 / d_shared_p50 if d_shared_p50 > 0 else 0.0
-    # both acceptance bars ride one gate dict (main() checks one per config)
+    # both acceptance bars ride one gate dict (main() checks one per config);
+    # the sanitizer's recompile count is a correctness bar that binds even
+    # where the throughput bars are CPU-unenforced
     g_conc = throughput_gate(ratio, 2.0, on_tpu, key="min_concurrency_ratio")
     g_ttft = throughput_gate(
         reduction, 0.30, on_tpu, key="min_shared_ttft_reduction"
     )
-    gate = {**g_conc, **g_ttft, "enforced": on_tpu,
-            "ok": g_conc["ok"] and g_ttft["ok"]}
+    recompiles = san["unexpected_recompiles"]
+    gate = {**g_conc, **g_ttft,
+            "unexpected_recompiles": recompiles,
+            "enforced": bool(on_tpu or recompiles > 0),
+            "ok": g_conc["ok"] and g_ttft["ok"] and recompiles == 0}
 
     return {
         "metric": "paged_vs_dense_concurrency_ratio",
@@ -752,6 +805,7 @@ def bench_paged_serving():
             all(dh.tokens == ph.tokens for dh, ph in zip(d_handles, p_handles))
         ),
         "flash_fallbacks": profiler.flash_fallback_summary(),
+        "sanitizer": san,
         "gate": gate,
         "note": "same KV rows both sides; dense commits slots*max_len up "
         "front, paged spends pages on lifetime spans and maps 70%-shared "

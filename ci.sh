@@ -19,6 +19,12 @@ if [ -n "$MODE" ] && [ "$MODE" != "fast" ] && [ "$MODE" != "chaos" ] && [ "$MODE
   exit 2
 fi
 
+echo "== static analysis (trace-purity + concurrency lint, GRAFT0xx) =="
+# the cheapest gate runs first in EVERY tier: pure-AST, no accelerator,
+# seconds — a recompile hazard or unlocked cross-thread mutation fails CI
+# before a single test collects
+env JAX_PLATFORMS=cpu python -m paddle_tpu.analysis paddle_tpu/ tests/
+
 if [ "$MODE" = "chaos-serve" ]; then
   echo "== serving chaos suite (fault drills + slow HTTP drill, hard 15min cap) =="
   # the drills assert the engine-level watchdog/supervisor recovery; the

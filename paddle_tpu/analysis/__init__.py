@@ -1,0 +1,112 @@
+"""paddle_tpu.analysis — trace-purity + concurrency sanitizer.
+
+Three layers, one rule table (see ``rules.RULES``):
+
+* static AST lint (``lint.py``): recompile hazards in hot/jitted code,
+  shape-vs-data confusion, undeclared FLAGS reads, unregistered fault
+  points — GRAFT001-006, GRAFT009;
+* concurrency pass (``concurrency.py``): unguarded cross-thread
+  mutation and lock-order inversion — GRAFT010/011;
+* runtime sanitizer (``sanitizer.py``): unexpected traces / eager
+  compiles / host syncs inside declared steady-state regions, behind
+  ``FLAGS_debug_sanitize`` — GRAFT020-022.
+
+CLI: ``python -m paddle_tpu.analysis [--fix-hints] [paths]`` (defaults
+to the package + tests); exits non-zero when findings survive the
+``# analysis: allow GRAFT0xx — reason`` suppressions.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from . import concurrency, lint, sanitizer  # noqa: F401  (public submodules)
+from .rules import RULES, Finding  # noqa: F401
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_PKG_DIR)  # the paddle_tpu package directory
+
+
+def hot(fn):
+    """Decorator marking a function as a hot path for the lint pass (the
+    decorator itself is a no-op; the AST pass recognizes the name)."""
+    return fn
+
+
+def run(paths, registry_roots=None) -> list[Finding]:
+    """Run every static pass over ``paths`` (files or directories) and
+    return post-suppression findings sorted by location.
+
+    Flag/fault-point declarations are always collected from the whole
+    ``paddle_tpu`` package (plus ``registry_roots``) so linting a subset
+    of files still resolves cross-file registries.
+    """
+    files = list(lint.iter_py_files(paths))
+    reg_paths = set(files)
+    reg_paths.update(lint.iter_py_files([_ROOT]))
+    for r in registry_roots or ():
+        reg_paths.update(lint.iter_py_files([r]))
+    reg = lint.collect_registry(sorted(reg_paths))
+
+    out: list[Finding] = []
+    for path in files:
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except OSError:
+            continue
+        out.extend(lint.lint_file(path, src=src, reg=reg))
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue  # already reported by lint_file
+        allows, _hot, _f = lint.scan_comments(src)
+        for f in concurrency.analyze_tree(tree, path):
+            lines = f.extra.get("lines", [f.line])
+            if any(lint._is_allowed(allows, ln, f.rule) for ln in lines):
+                continue
+            out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="static trace-purity + concurrency lint (GRAFT0xx rules)",
+    )
+    p.add_argument("paths", nargs="*", help="files or directories (default: package + tests)")
+    p.add_argument(
+        "--fix-hints", action="store_true",
+        help="print the one-line fix hint under every finding",
+    )
+    p.add_argument("--list-rules", action="store_true", help="print the rule table and exit")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES.values():
+            print(f"{r.id}  [{r.kind}] {r.title}")
+            print(f"    {r.hint}")
+        return 0
+
+    paths = args.paths
+    if not paths:
+        repo = os.path.dirname(_ROOT)
+        paths = [_ROOT]
+        tests = os.path.join(repo, "tests")
+        if os.path.isdir(tests):
+            paths.append(tests)
+
+    findings = run(paths)
+    for f in findings:
+        print(f.format(fix_hints=args.fix_hints))
+    n = len(findings)
+    if n:
+        print(f"\n{n} finding(s). Suppress deliberate ones with "
+              f"'# analysis: allow GRAFT0xx — reason'.")
+        return 1
+    print(f"paddle_tpu.analysis: 0 findings over {len(list(lint.iter_py_files(paths)))} files")
+    return 0

@@ -1,0 +1,521 @@
+"""Concurrency lint pass: lock/shared-state graph over threaded modules.
+
+Per class (and per module, for module-level worker threads like the
+watchdog monitor) this pass reconstructs:
+
+* **lock attributes** — ``self._mu = threading.Lock()/RLock()``;
+  ``threading.Condition(self._mu)`` aliases the wrapped lock, so holding
+  the condition counts as holding the lock;
+* **thread entries** — methods or nested functions passed as
+  ``threading.Thread(target=...)``;
+* a **self-call graph**, so every method carries the set of execution
+  contexts that can reach it: ``thread:<entry>`` labels plus
+  ``external`` for public methods callable from other threads;
+* **mutation sites** of shared attributes (assignment, augmented
+  assignment, subscript stores, and container mutators like
+  ``.append``/``.pop``/``.update``), each with the set of locks held —
+  tracked through ``with self._mu:`` blocks and through the
+  ``acquire(...)/release()`` try/finally idiom (approximated as the line
+  span between the acquire and the release).
+
+Findings:
+
+* **GRAFT010** — an attribute mutated from >=2 distinct contexts with no
+  single lock common to every mutation site;
+* **GRAFT011** — lock-order inversion: two code paths in the same class
+  acquire the same pair of locks in opposite orders (including one level
+  of acquisition through self-calls).
+
+The pass is intentionally scoped to classes/modules that own a lock or
+spawn a thread — everything else is single-threaded by construction and
+would only generate noise.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import defaultdict
+
+from .rules import Finding
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_MUTATOR_METHODS = {
+    "append", "appendleft", "pop", "popleft", "add", "remove", "discard",
+    "clear", "update", "extend", "insert", "setdefault",
+}
+# attribute types that are synchronization primitives — rebinding them is
+# part of lifecycle management, mutation through their own API is safe
+_PRIMITIVE_CTORS = _LOCK_CTORS | {"Event", "Semaphore", "BoundedSemaphore", "Barrier"}
+
+
+def _callee(func):
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _self_attr(node):
+    """'x' for ``self.x``, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _Site:
+    __slots__ = ("method", "line", "held", "kind")
+
+    def __init__(self, method, line, held, kind):
+        self.method = method
+        self.line = line
+        self.held = frozenset(held)
+        self.kind = kind
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef, path: str):
+        self.node = node
+        self.path = path
+        self.name = node.name
+        self.methods: dict[str, ast.FunctionDef] = {}
+        self.locks: dict[str, str] = {}  # attr -> canonical lock name
+        self.primitives: set[str] = set()
+        self.entries: set[str] = set()  # thread-entry method names
+        self.calls: dict[str, set[str]] = defaultdict(set)  # m -> callees
+        self.sites: dict[str, list[_Site]] = defaultdict(list)  # attr -> sites
+        self.acquires: dict[str, list[tuple]] = defaultdict(list)
+        #   method -> [(lock, held_before, line)]
+        self.call_sites: dict[str, list[tuple]] = defaultdict(list)
+        #   method -> [(callee, held, line)]
+
+
+def _collect_class(node: ast.ClassDef, path: str) -> _ClassInfo:
+    info = _ClassInfo(node, path)
+    for item in node.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info.methods[item.name] = item
+    # pass 1: locks / primitives / thread entries (anywhere in the class)
+    for fn in info.methods.values():
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+                ctor = _callee(sub.value.func)
+                for tgt in sub.targets:
+                    attr = _self_attr(tgt)
+                    if attr is None:
+                        continue
+                    if ctor in _LOCK_CTORS:
+                        canonical = attr
+                        if ctor == "Condition" and sub.value.args:
+                            inner = _self_attr(sub.value.args[0])
+                            if inner:
+                                canonical = inner
+                        info.locks[attr] = canonical
+                    if ctor in _PRIMITIVE_CTORS:
+                        info.primitives.add(attr)
+            if isinstance(sub, ast.Call) and _callee(sub.func) == "Thread":
+                for kw in sub.keywords:
+                    if kw.arg != "target":
+                        continue
+                    t = _self_attr(kw.value)
+                    if t:
+                        info.entries.add(t)
+                    elif isinstance(kw.value, ast.Name):
+                        info.entries.add(kw.value.id)
+    # pass 2: per-method walk with held-lock tracking
+    for name, fn in info.methods.items():
+        _walk_method(info, name, fn)
+    # nested functions used as thread targets (DataLoader worker pattern):
+    # treat them as entries belonging to their own thread context
+    for name, fn in info.methods.items():
+        for sub in ast.walk(fn):
+            if (
+                isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and sub.name in info.entries
+            ):
+                _walk_method(info, f"{name}.<{sub.name}>", sub, nested_entry=sub.name)
+    return info
+
+
+def _acquire_spans(fn: ast.AST, locks):
+    """Approximate lock spans for the ``ok = self._mu.acquire(...)`` /
+    ``finally: self._mu.release()`` idiom: the lock counts as held between
+    its first acquire line and its last release line in the function."""
+    spans = {}
+    acq, rel = {}, {}
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
+            attr = _self_attr(sub.func.value)
+            if attr in locks:
+                if sub.func.attr == "acquire":
+                    acq.setdefault(attr, sub.lineno)
+                elif sub.func.attr == "release":
+                    rel[attr] = max(rel.get(attr, 0), sub.lineno)
+    for attr, start in acq.items():
+        if attr in rel:
+            spans[locks[attr]] = (start, rel[attr])
+    return spans
+
+
+def _walk_method(info: _ClassInfo, label: str, fn: ast.AST, nested_entry=None):
+    spans = _acquire_spans(fn, info.locks)
+
+    def held_at(line, ctx_held):
+        held = set(ctx_held)
+        for lock, (a, b) in spans.items():
+            if a < line <= b:
+                held.add(lock)
+        return held
+
+    def visit(node, ctx_held):
+        # dispatch on the CHILDREN of node; dispatch() handles one node
+        # itself (so nested With/Call/Assign statements aren't skipped)
+        for child in ast.iter_child_nodes(node):
+            dispatch(child, ctx_held)
+
+    def dispatch(child, ctx_held):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if child.name == nested_entry or nested_entry is None:
+                # nested defs share the method's context only when we
+                # are explicitly walking an entry; otherwise they run
+                # on some other thread and are handled separately
+                if nested_entry is not None or child.name not in info.entries:
+                    visit(child, ctx_held if nested_entry else set())
+            return
+        if isinstance(child, ast.With):
+            inner = set(ctx_held)
+            for item in child.items:
+                attr = _self_attr(item.context_expr)
+                call_attr = None
+                if isinstance(item.context_expr, ast.Call):
+                    call_attr = _self_attr(item.context_expr.func)
+                a = attr or call_attr
+                if a in info.locks:
+                    lock = info.locks[a]
+                    info.acquires[label].append(
+                        (lock, frozenset(held_at(child.lineno, ctx_held)), child.lineno)
+                    )
+                    inner.add(lock)
+            for b in child.body:
+                dispatch(b, inner)
+            return
+        if isinstance(child, ast.Assign):
+            for tgt in child.targets:
+                _record_store(info, label, tgt, held_at(child.lineno, ctx_held))
+            dispatch(child.value, ctx_held)
+            return
+        if isinstance(child, ast.AugAssign):
+            _record_store(info, label, child.target, held_at(child.lineno, ctx_held))
+            dispatch(child.value, ctx_held)
+            return
+        if isinstance(child, ast.Call):
+            name = _callee(child.func)
+            if isinstance(child.func, ast.Attribute):
+                base = child.func.value
+                attr = _self_attr(base)
+                if attr is not None and name in _MUTATOR_METHODS:
+                    if attr not in info.locks and attr not in info.primitives:
+                        info.sites[attr].append(
+                            _Site(label, child.lineno, held_at(child.lineno, ctx_held), "mutate")
+                        )
+                if attr is not None and attr in info.locks and name == "acquire":
+                    info.acquires[label].append(
+                        (info.locks[attr], frozenset(held_at(child.lineno, ctx_held)), child.lineno)
+                    )
+                # self.method(...) call edge
+                m = _self_attr(child.func)
+                if m in info.methods:
+                    info.calls[label.split(".")[0]].add(m)
+                    info.call_sites[label].append(
+                        (m, frozenset(held_at(child.lineno, ctx_held)), child.lineno)
+                    )
+            for a in list(child.args) + [kw.value for kw in child.keywords]:
+                dispatch(a, ctx_held)
+            return
+        visit(child, ctx_held)
+
+    visit(fn, set())
+
+
+def _record_store(info: _ClassInfo, label, tgt, held):
+    attr = _self_attr(tgt)
+    if attr is not None:
+        if attr in info.locks or attr in info.primitives:
+            return
+        info.sites[attr].append(_Site(label, tgt.lineno, held, "assign"))
+        return
+    if isinstance(tgt, ast.Subscript):
+        attr = _self_attr(tgt.value)
+        if attr is not None and attr not in info.locks:
+            info.sites[attr].append(_Site(label, tgt.lineno, held, "setitem"))
+    elif isinstance(tgt, (ast.Tuple, ast.List)):
+        for e in tgt.elts:
+            _record_store(info, label, e, held)
+
+
+def _labels(info: _ClassInfo) -> dict[str, set[str]]:
+    """Execution-context labels per method: thread:<entry> for code
+    reachable from a thread entry, external for public surface."""
+    labels: dict[str, set[str]] = defaultdict(set)
+    for entry in info.entries:
+        if entry in info.methods:
+            labels[entry].add(f"thread:{entry}")
+    for name in info.methods:
+        if name == "__init__":
+            continue
+        if not name.startswith("_") or (name.startswith("__") and name.endswith("__")):
+            labels[name].add("external")
+    # propagate along the self-call graph to a fixpoint
+    changed = True
+    while changed:
+        changed = False
+        for caller, callees in info.calls.items():
+            for callee in callees:
+                if callee == "__init__":
+                    continue
+                add = labels.get(caller, set()) - labels.get(callee, set())
+                if add:
+                    labels[callee] |= add
+                    changed = True
+    return labels
+
+
+def _site_method(site_label: str) -> str:
+    return site_label.split(".")[0]
+
+
+def _infer_caller_locks(info: _ClassInfo) -> dict[str, frozenset]:
+    """If *every* call site of a private method holds lock L, treat L as
+    held throughout that method (the ``_locked``-suffix convention).
+    Computed to a fixpoint so the lock flows through call chains like
+    step() -> _decode_once() [with lock] -> _finish() -> _resolve()."""
+    held_in: dict[str, frozenset] = {}
+    callers: dict[str, list[tuple]] = defaultdict(list)
+    for label, sites in info.call_sites.items():
+        m = _site_method(label)
+        for callee, held, _line in sites:
+            callers[callee].append((m, held))
+    for _ in range(len(info.methods) + 1):
+        changed = False
+        for name in info.methods:
+            # public methods and thread entries run without caller context
+            if not name.startswith("_") or name.startswith("__") or name in info.entries:
+                continue
+            cs = callers.get(name)
+            if not cs:
+                continue
+            common = None
+            for caller, held in cs:
+                h = set(held) | set(held_in.get(caller, ()))
+                common = h if common is None else (common & h)
+            common = frozenset(common or ())
+            if common and common != held_in.get(name):
+                held_in[name] = common
+                changed = True
+        if not changed:
+            break
+    return held_in
+
+
+def analyze_tree(tree: ast.AST, path: str) -> list[Finding]:
+    out: list[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            info = _collect_class(node, path)
+            if not info.locks and not info.entries:
+                continue
+            out.extend(_check_class(info))
+    out.extend(_check_module_level(tree, path))
+    return out
+
+
+def _check_class(info: _ClassInfo) -> list[Finding]:
+    out: list[Finding] = []
+    labels = _labels(info)
+    caller_locks = _infer_caller_locks(info)
+    # single-threaded classes (lock but no threads touching it) still get
+    # the inversion check, but cross-thread mutation needs >=2 contexts
+    for attr, sites in sorted(info.sites.items()):
+        live = [s for s in sites if _site_method(s.method) != "__init__"]
+        if not live:
+            continue
+        ctxs = set()
+        for s in live:
+            ctxs |= labels.get(_site_method(s.method), set())
+            if "." in s.method:  # nested thread entry
+                ctxs.add(f"thread:{s.method.split('<')[-1].rstrip('>')}")
+        if len(ctxs) < 2 or not any(c.startswith("thread:") for c in ctxs):
+            continue
+        common = None
+        for s in live:
+            held = set(s.held) | set(caller_locks.get(_site_method(s.method), ()))
+            common = held if common is None else (common & held)
+        if common:
+            continue
+        first = min(live, key=lambda s: s.line)
+        out.append(
+            Finding(
+                "GRAFT010",
+                info.path,
+                first.line,
+                f"{info.name}.{attr} mutated from "
+                f"{len(ctxs)} contexts ({', '.join(sorted(ctxs))}) "
+                f"without a common lock",
+                detail=f"sites: {', '.join(str(s.line) for s in live)}",
+                extra={"lines": [s.line for s in live], "attr": attr},
+            )
+        )
+    out.extend(_check_inversions(info))
+    return out
+
+
+def _check_inversions(info: _ClassInfo) -> list[Finding]:
+    # direct edges (held -> acquired), plus one level through self-calls
+    edges: dict[tuple, int] = {}
+    method_acquires: dict[str, set[str]] = defaultdict(set)
+    for label, acqs in info.acquires.items():
+        for lock, _held, _line in acqs:
+            method_acquires[_site_method(label)].add(lock)
+    for label, acqs in info.acquires.items():
+        for lock, held, line in acqs:
+            for h in held:
+                if h != lock:
+                    edges.setdefault((h, lock), line)
+    for label, sites in info.call_sites.items():
+        for callee, held, line in sites:
+            for lock in method_acquires.get(callee, ()):
+                for h in held:
+                    if h != lock:
+                        edges.setdefault((h, lock), line)
+    out = []
+    seen = set()
+    for (a, b), line in sorted(edges.items(), key=lambda kv: kv[1]):
+        if (b, a) in edges and frozenset((a, b)) not in seen:
+            seen.add(frozenset((a, b)))
+            out.append(
+                Finding(
+                    "GRAFT011",
+                    info.path,
+                    line,
+                    f"{info.name}: lock order inversion between "
+                    f"{a!r} and {b!r} (also acquired in the opposite "
+                    f"order at line {edges[(b, a)]})",
+                    extra={"lines": [line, edges[(b, a)]]},
+                )
+            )
+    return out
+
+
+# --- module-level shared state (watchdog monitor / profiler pattern) --------
+
+
+def _check_module_level(tree: ast.AST, path: str) -> list[Finding]:
+    locks: set[str] = set()
+    containers: set[str] = set()
+    entries: set[str] = set()
+    funcs: dict[str, ast.FunctionDef] = {}
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            ctor = _callee(node.value.func)
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    if ctor in _LOCK_CTORS:
+                        locks.add(tgt.id)
+                    elif ctor in ("dict", "list", "set", "deque", "OrderedDict", "defaultdict"):
+                        containers.add(tgt.id)
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and isinstance(node.value, (ast.Dict, ast.List, ast.Set)):
+                    containers.add(tgt.id)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs[node.name] = node
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _callee(node.func) == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target" and isinstance(kw.value, ast.Name):
+                    entries.add(kw.value.id)
+    if not entries or not locks:
+        return []
+
+    sites: dict[str, list[tuple]] = defaultdict(list)  # name -> (fn, line, held)
+
+    def walk(fn_name, node, held):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(child, ast.With):
+                inner = set(held)
+                for item in child.items:
+                    if isinstance(item.context_expr, ast.Name) and item.context_expr.id in locks:
+                        inner.add(item.context_expr.id)
+                for b in child.body:
+                    walk(fn_name, b, inner)
+                continue
+            if isinstance(child, ast.Assign):
+                for tgt in child.targets:
+                    if isinstance(tgt, ast.Subscript) and isinstance(tgt.value, ast.Name):
+                        if tgt.value.id in containers:
+                            sites[tgt.value.id].append((fn_name, tgt.lineno, frozenset(held)))
+            if isinstance(child, ast.Global):
+                pass
+            walk(fn_name, child, held)
+
+    for name, fn in funcs.items():
+        walk(name, fn, set())
+    # rebinding via `global X; X = ...`
+    for name, fn in funcs.items():
+        globs = {
+            g for sub in ast.walk(fn) if isinstance(sub, ast.Global) for g in sub.names
+        }
+        if not globs:
+            continue
+        # reuse the with-tracking walk for assigns to global names
+        def walk2(node, held):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(child, ast.With):
+                    inner = set(held)
+                    for item in child.items:
+                        if isinstance(item.context_expr, ast.Name) and item.context_expr.id in locks:
+                            inner.add(item.context_expr.id)
+                    for b in child.body:
+                        walk2(b, inner)
+                    continue
+                if isinstance(child, ast.Assign):
+                    for tgt in child.targets:
+                        if isinstance(tgt, ast.Name) and tgt.id in globs:
+                            sites[tgt.id].append((name, tgt.lineno, frozenset(held)))
+                walk2(child, held)
+
+        walk2(fn, set())
+
+    out = []
+    for var, ss in sorted(sites.items()):
+        fns = {s[0] for s in ss}
+        in_thread = fns & entries
+        outside = fns - entries
+        if not in_thread or not outside:
+            continue
+        common = None
+        for _fn, _line, held in ss:
+            common = set(held) if common is None else (common & set(held))
+        if common:
+            continue
+        first = min(ss, key=lambda s: s[1])
+        out.append(
+            Finding(
+                "GRAFT010",
+                path,
+                first[1],
+                f"module global {var!r} mutated from thread entries "
+                f"({', '.join(sorted(in_thread))}) and "
+                f"{', '.join(sorted(outside))} without a common lock",
+                extra={"lines": [s[1] for s in ss], "attr": var},
+            )
+        )
+    return out

@@ -1,0 +1,525 @@
+"""AST lint passes: trace-purity (GRAFT001-004), FLAGS registry
+(GRAFT005), fault-point registry (GRAFT006), suppression hygiene
+(GRAFT009).
+
+Scope model for the trace-purity rules: a function is **hot** when it is
+
+* decorated with ``@to_static`` / ``@jit.to_static`` / ``@analysis.hot``,
+* annotated with a ``# analysis: hot`` comment on (or directly above) its
+  ``def`` line, or
+* referenced by name as an argument of a ``to_static(...)`` call anywhere
+  in the same file (the engine's ``self._decode_body =
+  jit.to_static(self._decode)`` pattern).
+
+Inside a hot function a small forward taint analysis tracks which locals
+are *tracer-derived*: parameters seed the taint set (except ``self`` /
+``cls`` and parameters with a constant default, which are static config
+by convention), taint propagates through arithmetic / indexing /
+generic calls, and is *stripped* by static metadata (``.shape``,
+``.ndim``, ``.dtype``, ``.size``, ``len()``, ``isinstance()``, ``is
+None``).  Python control flow, scalar casts, and shape positions are
+then checked against the taint set.  The analysis is deliberately
+intra-procedural and approximate — the point is catching the hazard
+classes that have actually bitten this repo, with a suppression escape
+hatch for the rest.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .rules import Finding
+
+# --- suppression / annotation comments -------------------------------------
+
+_ALLOW_RE = re.compile(
+    r"#\s*analysis:\s*allow\s+(GRAFT\d{3})\b\s*(?:[-—:(—]\s*)?(.*)$"
+)
+_HOT_RE = re.compile(r"#\s*analysis:\s*hot\b")
+
+# names whose call result is static even when args are traced
+_UNTAINT_CALLS = {"len", "isinstance", "hasattr", "type", "id", "getattr"}
+# attribute reads that yield static metadata, not data
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "name"}
+# method calls that are host syncs (GRAFT003 in hot code)
+_SYNC_METHODS = {"numpy", "item", "tolist", "block_until_ready"}
+# scalar casts that force a host round-trip on a tracer (GRAFT002)
+_CAST_FUNCS = {"int", "bool", "float"}
+
+# shape-position tables for GRAFT004: callee name -> indices of positional
+# args that are shapes/sizes, plus keyword names that are shapes.
+# ``None`` index means "every positional arg" (the x.reshape(2, 3) form).
+_SHAPE_METHOD_ARGS = {"reshape": (None, ("shape",))}
+_SHAPE_FUNC_ARGS = {
+    "reshape": ((1,), ("shape",)),
+    "zeros": ((0,), ("shape",)),
+    "ones": ((0,), ("shape",)),
+    "full": ((0,), ("shape",)),
+    "empty": ((0,), ("shape",)),
+    "broadcast_to": ((1,), ("shape",)),
+    "dynamic_slice": ((2,), ("slice_sizes",)),
+    "dynamic_slice_in_dim": ((2,), ("slice_size",)),
+}
+
+_FAULT_CALLS = {"inject", "should_fire", "inject_hang"}
+
+
+def scan_comments(src: str):
+    """Return (allows, hot_lines, findings) from the raw source.
+
+    ``allows`` maps line -> set of rule ids suppressed *at* that line; an
+    allow comment also covers the next line so it can sit on its own line
+    above the flagged statement.  A bare allow with no reason is itself a
+    finding (GRAFT009) — the suppression still applies so a missing
+    reason produces exactly one actionable diagnostic.
+    """
+    allows: dict[int, set[str]] = {}
+    hot_lines: set[int] = set()
+    findings: list[Finding] = []
+    for i, text in enumerate(src.splitlines(), start=1):
+        if "#" not in text:
+            continue
+        m = _ALLOW_RE.search(text)
+        if m:
+            rule, reason = m.group(1), m.group(2).strip().strip(")")
+            for ln in (i, i + 1):
+                allows.setdefault(ln, set()).add(rule)
+            if not reason:
+                findings.append(
+                    Finding("GRAFT009", "", i, f"allow {rule} has no reason")
+                )
+        if _HOT_RE.search(text):
+            hot_lines.add(i)
+    return allows, hot_lines, findings
+
+
+def _is_allowed(allows, line, rule):
+    return rule in allows.get(line, ())
+
+
+# --- declaration collectors (whole-tree registries) -------------------------
+
+
+class Registry:
+    """Declared FLAGS_* names and registered fault-point names, collected
+    across every file of the package tree so that linting a subset of
+    paths still sees the full registries."""
+
+    def __init__(self):
+        self.flags: set[str] = set()
+        self.fault_points: set[str] = set()
+
+    def collect(self, tree: ast.AST):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _callee_name(node.func)
+            if name == "define_flag" and node.args:
+                v = _literal_str(node.args[0])
+                if v:
+                    self.flags.add(v)
+            elif name == "register" and node.args:
+                v = _literal_str(node.args[0])
+                if v:
+                    self.fault_points.add(v)
+
+
+def _callee_name(func: ast.AST) -> str:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def _literal_str(node: ast.AST):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# --- hot-function discovery -------------------------------------------------
+
+
+def _decorator_marks_hot(dec: ast.AST) -> bool:
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    name = _callee_name(target) or (
+        target.id if isinstance(target, ast.Name) else ""
+    )
+    return name in ("to_static", "hot")
+
+
+def _to_static_arg_names(tree: ast.AST) -> set[str]:
+    """Function/method names passed into a to_static(...) call anywhere in
+    the file — e.g. jit.to_static(self._decode) marks _decode as hot."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _callee_name(node.func) == "to_static":
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    names.add(arg.id)
+                elif isinstance(arg, ast.Attribute):
+                    names.add(arg.attr)
+    return names
+
+
+def _iter_functions(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+# --- taint analysis inside one hot function ---------------------------------
+
+
+class _TaintChecker:
+    def __init__(self, fn: ast.FunctionDef, path: str, out: list[Finding]):
+        self.fn = fn
+        self.path = path
+        self.out = out
+        self.tainted: set[str] = set()
+        self._seed_params(fn)
+
+    def _seed_params(self, fn):
+        a = fn.args
+        params = list(a.posonlyargs) + list(a.args)
+        # params with a constant default are static config, not operands
+        n_def = len(a.defaults)
+        defaulted = {p.arg for p in params[len(params) - n_def:]} if n_def else set()
+        defaulted |= {
+            kw.arg
+            for kw, d in zip(a.kwonlyargs, a.kw_defaults)
+            if d is not None
+        }
+        for p in params + list(a.kwonlyargs):
+            if p.arg in ("self", "cls") or p.arg in defaulted:
+                continue
+            self.tainted.add(p.arg)
+
+    # -- expression taint ---------------------------------------------------
+
+    def t(self, node) -> bool:
+        if node is None or isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.t(node.value)
+        if isinstance(node, ast.Call):
+            name = _callee_name(node.func)
+            if name in _UNTAINT_CALLS:
+                return False
+            if isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_METHODS:
+                return False  # result is host data (the sync itself is GRAFT003)
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            return any(self.t(a) for a in args) or self.t(node.func)
+        if isinstance(node, ast.BinOp):
+            return self.t(node.left) or self.t(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.t(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.t(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return self.t(node.left) or any(self.t(c) for c in node.comparators)
+        if isinstance(node, ast.Subscript):
+            return self.t(node.value) or self.t(node.slice)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.t(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return self.t(node.body) or self.t(node.orelse) or self.t(node.test)
+        if isinstance(node, ast.Slice):
+            return any(self.t(x) for x in (node.lower, node.upper, node.step))
+        if isinstance(node, ast.Starred):
+            return self.t(node.value)
+        return False
+
+    # -- fixpoint over assignments so loop-carried taint converges ----------
+
+    def propagate(self):
+        for _ in range(2):
+            before = len(self.tainted)
+            for node in ast.walk(self.fn):
+                if isinstance(node, ast.Assign) and self.t(node.value):
+                    for tgt in node.targets:
+                        self._taint_target(tgt)
+                elif isinstance(node, ast.AugAssign):
+                    if self.t(node.value) or self.t(node.target):
+                        self._taint_target(node.target)
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    if self.t(node.value):
+                        self._taint_target(node.target)
+                elif isinstance(node, ast.For) and self.t(node.iter):
+                    self._taint_target(node.target)
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if node is not self.fn:
+                        # nested traced bodies (lax loop carries): params traced
+                        for p in node.args.args + node.args.posonlyargs:
+                            if p.arg not in ("self", "cls"):
+                                self.tainted.add(p.arg)
+                elif isinstance(node, ast.Lambda):
+                    for p in node.args.args:
+                        self.tainted.add(p.arg)
+            if len(self.tainted) == before:
+                break
+
+    def _taint_target(self, tgt):
+        if isinstance(tgt, ast.Name):
+            self.tainted.add(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._taint_target(e)
+        elif isinstance(tgt, ast.Starred):
+            self._taint_target(tgt.value)
+
+    # -- checks -------------------------------------------------------------
+
+    def check(self):
+        self.propagate()
+        fname = self.fn.name
+        for node in ast.walk(self.fn):
+            if isinstance(node, (ast.If, ast.While)) and self.t(node.test):
+                self.out.append(
+                    Finding(
+                        "GRAFT001",
+                        self.path,
+                        node.test.lineno,
+                        f"Python {'while' if isinstance(node, ast.While) else 'if'} "
+                        f"on a traced value in hot function {fname!r}",
+                    )
+                )
+            elif isinstance(node, ast.IfExp) and self.t(node.test):
+                self.out.append(
+                    Finding(
+                        "GRAFT001",
+                        self.path,
+                        node.lineno,
+                        f"ternary on a traced value in hot function {fname!r}",
+                    )
+                )
+            elif isinstance(node, ast.For) and self._range_tainted(node.iter):
+                self.out.append(
+                    Finding(
+                        "GRAFT001",
+                        self.path,
+                        node.lineno,
+                        f"loop trip count from a traced value in hot function {fname!r}",
+                    )
+                )
+            elif isinstance(node, ast.Call):
+                self._check_call(node, fname)
+
+    def _range_tainted(self, it):
+        return (
+            isinstance(it, ast.Call)
+            and _callee_name(it.func) == "range"
+            and any(self.t(a) for a in it.args)
+        )
+
+    def _check_call(self, node: ast.Call, fname: str):
+        name = _callee_name(node.func)
+        if name in _CAST_FUNCS and isinstance(node.func, ast.Name):
+            if any(self.t(a) for a in node.args):
+                self.out.append(
+                    Finding(
+                        "GRAFT002",
+                        self.path,
+                        node.lineno,
+                        f"{name}() on a traced value in hot function {fname!r}",
+                    )
+                )
+                return
+        if isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_METHODS:
+            self.out.append(
+                Finding(
+                    "GRAFT003",
+                    self.path,
+                    node.lineno,
+                    f".{node.func.attr}() host sync in hot function {fname!r}",
+                )
+            )
+            return
+        self._check_shape_positions(node, fname)
+
+    def _check_shape_positions(self, node: ast.Call, fname: str):
+        name = _callee_name(node.func)
+        is_method = isinstance(node.func, ast.Attribute)
+        spec = None
+        if is_method and name in _SHAPE_METHOD_ARGS:
+            spec = _SHAPE_METHOD_ARGS[name]
+        elif name in _SHAPE_FUNC_ARGS and (
+            not is_method or name not in _SHAPE_METHOD_ARGS
+        ):
+            spec = _SHAPE_FUNC_ARGS[name]
+        if spec is None:
+            return
+        idxs, kws = spec
+        bad = None
+        if idxs is None:  # x.reshape(a, b, ...): every positional arg is shape
+            for a in node.args:
+                if self.t(a):
+                    bad = a
+                    break
+        else:
+            for i in idxs:
+                if i < len(node.args) and self.t(node.args[i]):
+                    bad = node.args[i]
+                    break
+        if bad is None:
+            for kw in node.keywords:
+                if kw.arg in kws and self.t(kw.value):
+                    bad = kw.value
+                    break
+        if bad is not None:
+            self.out.append(
+                Finding(
+                    "GRAFT004",
+                    self.path,
+                    node.lineno,
+                    f"array value flows into a shape position of {name}() "
+                    f"in hot function {fname!r}",
+                )
+            )
+
+
+# --- registry checks (any function, hot or not) -----------------------------
+
+
+def _check_registries(tree, path, reg: Registry, out: list[Finding]):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _callee_name(node.func)
+        if name == "flag" and node.args:
+            v = _literal_str(node.args[0])
+            if v and v.startswith("FLAGS_") and v not in reg.flags:
+                out.append(
+                    Finding(
+                        "GRAFT005", path, node.lineno,
+                        f"read of undeclared flag {v!r}",
+                    )
+                )
+        elif name == "set_flags" and node.args:
+            d = node.args[0]
+            if isinstance(d, ast.Dict):
+                for k in d.keys:
+                    v = _literal_str(k)
+                    if v and v.startswith("FLAGS_") and v not in reg.flags:
+                        out.append(
+                            Finding(
+                                "GRAFT005", path, k.lineno,
+                                f"set_flags of undeclared flag {v!r}",
+                            )
+                        )
+        elif name in ("get", "getenv", "setdefault", "pop") or name == "__getitem__":
+            v = node.args and _literal_str(node.args[0]) or None
+            if v and v.startswith("FLAGS_") and v not in reg.flags:
+                if _is_environ_call(node.func):
+                    out.append(
+                        Finding(
+                            "GRAFT005", path, node.lineno,
+                            f"environment read of undeclared flag {v!r}",
+                        )
+                    )
+        elif name in _FAULT_CALLS and node.args:
+            v = _literal_str(node.args[0])
+            if v and v not in reg.fault_points:
+                out.append(
+                    Finding(
+                        "GRAFT006", path, node.lineno,
+                        f"fault point {v!r} fired but never registered",
+                    )
+                )
+    # os.environ["FLAGS_x"] subscript reads
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Subscript) and _is_environ(node.value):
+            v = _literal_str(node.slice)
+            if v and v.startswith("FLAGS_") and v not in reg.flags:
+                out.append(
+                    Finding(
+                        "GRAFT005", path, node.lineno,
+                        f"environment read of undeclared flag {v!r}",
+                    )
+                )
+
+
+def _is_environ(node) -> bool:
+    return (
+        isinstance(node, ast.Attribute) and node.attr == "environ"
+    ) or (isinstance(node, ast.Name) and node.id == "environ")
+
+
+def _is_environ_call(func) -> bool:
+    return isinstance(func, ast.Attribute) and (
+        _is_environ(func.value) or (isinstance(func.value, ast.Name) and func.value.id == "os")
+    )
+
+
+# --- per-file driver --------------------------------------------------------
+
+
+def lint_file(path: str, src: str | None = None, reg: Registry | None = None):
+    """Lint one file; ``reg`` holds the whole-tree registries (built by the
+    caller).  Returns the post-suppression findings list."""
+    if src is None:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding("GRAFT009", path, e.lineno or 1, f"unparseable file: {e.msg}")]
+    allows, hot_lines, findings = scan_comments(src)
+    for f in findings:
+        f.path = path
+
+    if reg is None:
+        reg = Registry()
+        reg.collect(tree)
+
+    hot_names = _to_static_arg_names(tree)
+    out: list[Finding] = list(findings)
+    for fn in _iter_functions(tree):
+        hot = (
+            any(_decorator_marks_hot(d) for d in fn.decorator_list)
+            or fn.name in hot_names
+            or fn.lineno in hot_lines
+            or (fn.lineno - 1) in hot_lines
+            or any(ln in hot_lines for ln in range(fn.lineno, fn.body[0].lineno))
+        )
+        if hot:
+            _TaintChecker(fn, path, out).check()
+    _check_registries(tree, path, reg, out)
+
+    return [f for f in out if not _is_allowed(allows, f.line, f.rule)]
+
+
+def collect_registry(paths) -> Registry:
+    """Build the declared-flag / fault-point registry from a list of .py
+    files (the caller passes the whole package so linting a subset still
+    resolves cross-file declarations)."""
+    reg = Registry()
+    for p in paths:
+        try:
+            with open(p, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=p)
+        except (OSError, SyntaxError):
+            continue
+        reg.collect(tree)
+    return reg
+
+
+def iter_py_files(paths):
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs if d not in ("__pycache__", ".git")]
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+        elif p.endswith(".py"):
+            yield p

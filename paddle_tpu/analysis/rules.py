@@ -1,0 +1,144 @@
+"""Rule registry shared by the static lint passes, the concurrency pass,
+and the runtime sanitizer.
+
+Every finding in the analyzer carries one of these rule ids (``GRAFT0xx``),
+a source location, and the rule's one-line fix hint.  The ids are stable:
+suppression comments (``# analysis: allow GRAFT0xx — reason``) and the
+README rule table reference them, so renumbering is an API break.
+
+Keep this module stdlib-only and import-light: the CLI runs as a fast
+fail-early CI gate and must not drag the accelerator runtime in.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    title: str
+    hint: str
+    kind: str  # "lint" | "concurrency" | "runtime"
+
+
+# ---------------------------------------------------------------------------
+# rule table — the single source of truth (README renders this)
+# ---------------------------------------------------------------------------
+
+_ALL = [
+    Rule(
+        "GRAFT001",
+        "Python control flow on a traced value",
+        "a Python if/while/for on a tracer re-traces per value; branch on "
+        "data with jnp.where/lax.cond, or hoist the decision to static config",
+        "lint",
+    ),
+    Rule(
+        "GRAFT002",
+        "Python scalar cast of a traced value",
+        "int()/bool()/float() on a tracer forces a host sync and bakes a "
+        "constant into the graph; keep the value as traced data",
+        "lint",
+    ),
+    Rule(
+        "GRAFT003",
+        "host sync in a hot path",
+        ".numpy()/.item()/.tolist()/block_until_ready() stalls the dispatch "
+        "pipeline; defer the fetch to a flush boundary or wrap the site in "
+        "sanitizer.allowed_sync(...)",
+        "lint",
+    ),
+    Rule(
+        "GRAFT004",
+        "array value used in a shape position",
+        "shapes must come from .shape/static config, never from array "
+        "values; a data-dependent shape recompiles per value",
+        "lint",
+    ),
+    Rule(
+        "GRAFT005",
+        "undeclared FLAGS_* name",
+        "declare it with define_flag(...) in framework/core.py (or the "
+        "owning module), or fix the spelling",
+        "lint",
+    ),
+    Rule(
+        "GRAFT006",
+        "unregistered fault-injection point",
+        "register(name, doc) in fault/injection.py (or the owning module) "
+        "before firing it",
+        "lint",
+    ),
+    Rule(
+        "GRAFT009",
+        "suppression without a reason",
+        "write '# analysis: allow GRAFT0xx — why this is safe'; a bare "
+        "allow hides the decision from the next reader",
+        "lint",
+    ),
+    Rule(
+        "GRAFT010",
+        "attribute mutated from >=2 threads without a common lock",
+        "guard every mutation site with one shared lock, or annotate the "
+        "benign race with '# analysis: allow GRAFT010 — reason'",
+        "concurrency",
+    ),
+    Rule(
+        "GRAFT011",
+        "lock-order inversion",
+        "two code paths acquire the same pair of locks in opposite order; "
+        "pick one global order and acquire in it everywhere",
+        "concurrency",
+    ),
+    Rule(
+        "GRAFT020",
+        "unexpected fresh trace in a steady-state region",
+        "a warmed region re-traced: an operand became a Python value / a "
+        "new signature leaked in; fix the caller or wrap a legitimate "
+        "growth path in sanitizer.allow(...)",
+        "runtime",
+    ),
+    Rule(
+        "GRAFT021",
+        "unexpected eager compile in a steady-state region",
+        "an eager op missed the dispatch cache mid-steady-state; hoist the "
+        "op out of the hot loop or widen the warmup",
+        "runtime",
+    ),
+    Rule(
+        "GRAFT022",
+        "unexpected host sync in a steady-state region",
+        "a device->host fetch ran inside the serving scheduler / in-flight "
+        "ring; batch it at a flush boundary or wrap it in "
+        "sanitizer.allowed_sync(...)",
+        "runtime",
+    ),
+]
+
+RULES: dict[str, Rule] = {r.id: r for r in _ALL}
+
+
+@dataclass
+class Finding:
+    """One analyzer finding: rule id + location + message (+ fix hint)."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    detail: str = ""
+    extra: dict = field(default_factory=dict)
+
+    @property
+    def hint(self) -> str:
+        return RULES[self.rule].hint
+
+    def format(self, fix_hints: bool = False) -> str:
+        s = f"{self.rule} {self.path}:{self.line}: {self.message}"
+        if self.detail:
+            s += f" [{self.detail}]"
+        if fix_hints:
+            s += f"\n    hint: {self.hint}"
+        return s

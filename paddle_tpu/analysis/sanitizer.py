@@ -1,0 +1,259 @@
+"""Runtime trace/sync sanitizer behind ``FLAGS_debug_sanitize``.
+
+The static passes catch hazards you can see in the source; this module
+catches the ones you can't — a fresh trace, an eager-cache miss, or a
+device->host sync that happens *at runtime* inside a region that has
+declared itself steady-state (the serving scheduler after warmup, the
+in-flight ring after the first step).  Instrumented framework code calls
+the tiny ``note_*`` hooks; they are no-ops unless the flag is on AND the
+current thread is inside a ``steady_state(...)`` region, so the hot path
+cost when disabled is one dict lookup.
+
+Every violation is attributed to the *user-level* source line by walking
+the stack past framework frames (everything under the ``paddle_tpu``
+package directory), and recorded as a Finding with a runtime rule id:
+
+* GRAFT020 — unexpected fresh trace (``jit.StaticFunction._trace``)
+* GRAFT021 — unexpected eager compile (``ops.dispatch`` cache miss,
+  ``jit.cache`` snapshot miss)
+* GRAFT022 — unexpected host sync (``Tensor.numpy()/item()``)
+
+Legitimate exceptions are declared in code, not config:
+``allow(reason)`` wraps a growth path (e.g. the engine tracing a fresh
+prefill bucket for an over-length prompt), ``allowed_sync(what)`` wraps
+a sanctioned fetch (the engine's batched token flush).  Findings surface
+three ways: ``profiler.summary()`` prints the report, ``check()`` raises
+(the conftest teardown makes it a hard test error), and bench legs fail
+their gate when the unexpected-recompile counter moves.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from contextlib import contextmanager
+
+from .rules import Finding
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SELF_DIR = os.path.dirname(os.path.abspath(__file__))
+
+_state = threading.local()
+_lock = threading.Lock()
+_findings: list[Finding] = []
+_counters = {
+    "traces": 0,
+    "eager_misses": 0,
+    "host_syncs": 0,
+    "unexpected_traces": 0,
+    "unexpected_eager": 0,
+    "unexpected_syncs": 0,
+    "allowed_events": 0,
+}
+
+
+def enabled() -> bool:
+    from ..framework import core
+
+    try:
+        return bool(core.flag("FLAGS_debug_sanitize"))
+    except KeyError:  # registry not initialised yet (import order)
+        return False
+
+
+def _zones() -> list:
+    z = getattr(_state, "zones", None)
+    if z is None:
+        z = _state.zones = []
+    return z
+
+
+def _allows() -> list:
+    a = getattr(_state, "allows", None)
+    if a is None:
+        a = _state.allows = []
+    return a
+
+
+def zone_active() -> bool:
+    return bool(getattr(_state, "zones", None))
+
+
+@contextmanager
+def steady_state(region: str):
+    """Declare a no-fresh-trace / no-host-sync region on this thread."""
+    if not enabled():
+        yield
+        return
+    _zones().append(region)
+    try:
+        yield
+    finally:
+        _zones().pop()
+
+
+@contextmanager
+def allow(reason: str):
+    """Declare that traces/compiles/syncs inside are sanctioned (e.g. the
+    engine growing a fresh prefill bucket)."""
+    _allows().append(reason)
+    try:
+        yield
+    finally:
+        _allows().pop()
+
+
+@contextmanager
+def allowed_sync(what: str):
+    """Sanctioned host sync inside a steady-state region (flush-boundary
+    token fetches and the like)."""
+    _allows().append(what)
+    try:
+        yield
+    finally:
+        _allows().pop()
+
+
+def _attribute():
+    """(user_frame, framework_frame): innermost frame outside the
+    paddle_tpu package, plus the innermost framework frame for detail."""
+    stack = traceback.extract_stack()[:-2]  # drop _attribute + note_*
+    user = None
+    fw = None
+    for fr in reversed(stack):
+        fname = os.path.abspath(fr.filename)
+        if fname.startswith(_PKG_DIR + os.sep) or fname == _PKG_DIR:
+            if fw is None and not fname.startswith(_SELF_DIR + os.sep):
+                fw = fr
+            continue
+        user = fr
+        break
+    if user is None and stack:
+        user = stack[-1]
+    return user, fw
+
+
+def _record(rule: str, counter: str, message: str):
+    if _allows():
+        with _lock:
+            _counters["allowed_events"] += 1
+        return
+    user, fw = _attribute()
+    detail = ""
+    if fw is not None:
+        detail = f"via {os.path.relpath(fw.filename, _PKG_DIR)}:{fw.lineno}"
+    zone = _zones()[-1] if zone_active() else "?"
+    f = Finding(
+        rule,
+        user.filename if user else "?",
+        user.lineno if user else 0,
+        f"{message} inside steady-state region {zone!r}",
+        detail=detail,
+    )
+    with _lock:
+        _counters[counter] += 1
+        if len(_findings) < 200:  # bound memory under a pathological loop
+            _findings.append(f)
+
+
+# --- hooks called from instrumented framework code --------------------------
+
+
+def note_trace(name: str):
+    """A StaticFunction traced a fresh signature."""
+    if not enabled():
+        return
+    with _lock:
+        _counters["traces"] += 1
+    if not zone_active():
+        return
+    _record("GRAFT020", "unexpected_traces", f"fresh trace of {name!r}")
+
+
+def note_eager_miss(what: str):
+    """The eager dispatch cache (or AOT snapshot tier) missed and built a
+    new executable."""
+    if not enabled():
+        return
+    with _lock:
+        _counters["eager_misses"] += 1
+    if not zone_active():
+        return
+    _record("GRAFT021", "unexpected_eager", f"eager compile of {what}")
+
+
+def note_host_sync(what: str):
+    """A device->host materialization ran (Tensor.numpy()/item())."""
+    if not enabled():
+        return
+    with _lock:
+        _counters["host_syncs"] += 1
+    if not zone_active():
+        return
+    _record("GRAFT022", "unexpected_syncs", f"host sync ({what})")
+
+
+# --- reporting --------------------------------------------------------------
+
+
+def findings() -> list[Finding]:
+    with _lock:
+        return list(_findings)
+
+
+def counters() -> dict:
+    with _lock:
+        return dict(_counters)
+
+
+def unexpected() -> int:
+    with _lock:
+        return (
+            _counters["unexpected_traces"]
+            + _counters["unexpected_eager"]
+            + _counters["unexpected_syncs"]
+        )
+
+
+def reset():
+    with _lock:
+        _findings.clear()
+        for k in _counters:
+            _counters[k] = 0
+    _state.zones = []
+    _state.allows = []
+
+
+def check():
+    """Raise if any unexpected trace/compile/sync was recorded — the
+    conftest teardown calls this so violations are hard test errors."""
+    fs = findings()
+    if fs:
+        lines = "\n".join("  " + f.format(fix_hints=True) for f in fs[:20])
+        raise AssertionError(
+            f"sanitizer: {len(fs)} unexpected event(s) in steady-state "
+            f"regions (FLAGS_debug_sanitize):\n{lines}"
+        )
+
+
+def report() -> str:
+    """Human-readable block for profiler.summary(); empty when quiet."""
+    c = counters()
+    fs = findings()
+    if not any(c.values()) and not fs:
+        return ""
+    out = [
+        "sanitizer: traces=%d eager_misses=%d host_syncs=%d "
+        "unexpected=%d allowed=%d"
+        % (
+            c["traces"],
+            c["eager_misses"],
+            c["host_syncs"],
+            c["unexpected_traces"] + c["unexpected_eager"] + c["unexpected_syncs"],
+            c["allowed_events"],
+        )
+    ]
+    for f in fs[:10]:
+        out.append("  " + f.format())
+    return "\n".join(out)

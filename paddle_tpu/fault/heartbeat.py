@@ -144,6 +144,10 @@ class HeartbeatWriter:
         self.seq = 0
         self.step = None
         self.status = STATUS_RUNNING
+        # beat()/set_step() are called from BOTH the interval thread and the
+        # training loop; the lock makes each payload a consistent
+        # (seq, step, status) snapshot and seq strictly monotonic
+        self._mu = threading.Lock()
         self._stop = threading.Event()
         self._thread = None
         os.makedirs(self.root, exist_ok=True)
@@ -163,21 +167,25 @@ class HeartbeatWriter:
                 logger.warning("heartbeat write failed: %s", e)
 
     def set_step(self, step):
-        self.step = int(step)
+        with self._mu:
+            self.step = int(step)
 
     def beat(self, step=None):
-        if step is not None:
-            self.step = int(step)
-        self.seq += 1
-        payload = {
-            "seq": self.seq,
-            "mono": time.monotonic(),
-            "time": time.time(),
-            "step": self.step,
-            "status": self.status,
-            "pid": os.getpid(),
-        }
-        _atomic_write(hb_path(self.root, self.rank), payload)
+        with self._mu:
+            if step is not None:
+                self.step = int(step)
+            self.seq += 1
+            payload = {
+                "seq": self.seq,
+                "mono": time.monotonic(),
+                "time": time.time(),
+                "step": self.step,
+                "status": self.status,
+                "pid": os.getpid(),
+            }
+            # write inside the lock: concurrent beats must not land their
+            # files out of order (a regressing seq looks like a stall)
+            _atomic_write(hb_path(self.root, self.rank), payload)
         from . import injection as _inj
 
         _inj.record_event("heartbeat", f"rank {self.rank} seq {self.seq} step {self.step}")

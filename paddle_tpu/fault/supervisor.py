@@ -343,6 +343,10 @@ class EngineSupervisor:
         self.stall_timeout = stall_timeout
         self.restarts = 0
         self.dead = False
+        # restart() is driven by the poll thread but is also public API
+        # (tests / manual ops); the budget counters move under this lock so
+        # concurrent callers cannot double-spend a restart
+        self._state_mu = threading.Lock()
         self._stop = threading.Event()
         self._thread = None
 
@@ -410,22 +414,32 @@ class EngineSupervisor:
     def restart(self, reason):
         """Bounded restart-with-backoff; past the budget, declare the
         engine dead and fail everything pending with typed errors."""
-        if self.restarts >= self.max_restarts:
+        # spend the budget under the state lock (restart() races between the
+        # poll thread and external callers), but never hold it across the
+        # backoff sleep or the engine restart itself
+        with self._state_mu:
+            if self.restarts >= self.max_restarts:
+                exhausted, spent = True, self.restarts
+            else:
+                exhausted = False
+                delay = min(self.backoff * (2 ** self.restarts), self.backoff_max)
+                self.restarts += 1
+                spent = self.restarts
+        if exhausted:
             logger.error(
                 "engine supervisor: restart budget (%d) exhausted (%s); "
                 "declaring the engine dead", self.max_restarts, reason,
             )
             _inj.record_event(
-                "engine", f"restart budget exhausted after {self.restarts} ({reason})"
+                "engine", f"restart budget exhausted after {spent} ({reason})"
             )
-            self.dead = True
+            with self._state_mu:
+                self.dead = True
             self.engine.fail_all(f"restart budget exhausted ({reason})")
             return False
-        delay = min(self.backoff * (2 ** self.restarts), self.backoff_max)
-        self.restarts += 1
         logger.error(
             "engine supervisor: %s; engine restart %d/%d in %.2fs",
-            reason, self.restarts, self.max_restarts, delay,
+            reason, spent, self.max_restarts, delay,
         )
         if delay > 0:
             time.sleep(delay)

@@ -492,6 +492,15 @@ define_flag(
     "copy-on-write into partially filled pages) and prefills only its "
     "unshared suffix",
 )
+define_flag(
+    "FLAGS_debug_sanitize", False,
+    "runtime trace/sync sanitizer (paddle_tpu.analysis.sanitizer): count "
+    "every fresh trace, eager-cache miss, and device->host sync; inside a "
+    "declared steady-state region (serving scheduler after warmup, the "
+    "in-flight ring) any unexpected one is attributed to its user-level "
+    "source line, surfaced in profiler.summary(), and raised as a hard "
+    "error by the test suite's sanitize fixture",
+)
 
 
 # ---------------------------------------------------------------------------

@@ -3,9 +3,25 @@
 
 from __future__ import annotations
 
+import contextlib
+
 from .. import ops
 from ..io import DataLoader
 from ..tensor import Tensor
+
+
+def _batch_signature(*tensors):
+    """(shape, dtype) tuple per input, or None when an input has no shape —
+    the fit loop compares consecutive signatures to decide when the step
+    dispatch has entered steady state (same signature => no retrace is
+    legitimate)."""
+    sig = []
+    for t in tensors:
+        shape = getattr(t, "shape", None)
+        if shape is None:
+            return None
+        sig.append((tuple(shape), str(getattr(t, "dtype", ""))))
+    return tuple(sig)
 
 
 def _materialize_losses(raws):
@@ -233,6 +249,7 @@ class Model:
         import time
 
         from ..fault import Supervisor
+        from ..analysis import sanitizer as _san
         from ..fault import watchdog as _wd
         from ..framework import core as _core
         from .. import profiler as _prof
@@ -283,12 +300,26 @@ class Model:
                     return vals
 
                 last_end = time.perf_counter()
+                prev_sig = None
                 for step, batch in enumerate(loader):
                     cblist.call("on_train_batch_begin", step)
                     x, y = batch[0], batch[1]
+                    # once the batch signature repeats, the step dispatch is
+                    # steady-state: a fresh trace (a shape/dtype leak) or a
+                    # host sync inside train_batch is a sanitizer finding.
+                    # A changed signature (first step, ragged last batch) is
+                    # a legitimate retrace and stays outside the region.
+                    sig = _batch_signature(x, y)
+                    ss = (
+                        _san.steady_state("fit.inflight_ring")
+                        if sig is not None and sig == prev_sig and _san.enabled()
+                        else contextlib.nullcontext()
+                    )
+                    prev_sig = sig
                     t0 = time.perf_counter()
                     with sup.guard(), _wd.arm("fit.train_batch", context=f"step {step}"):
-                        loss_t = self.train_batch(x, y)[0]
+                        with ss:
+                            loss_t = self.train_batch(x, y)[0]
                     t1 = time.perf_counter()
                     window.append(getattr(loss_t, "_raw", loss_t))
                     sup.after_step(loss_t)  # deferred: heartbeat + preemption

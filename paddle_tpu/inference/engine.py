@@ -77,6 +77,7 @@ Serving fault domain (the serving mirror of the training fault domain):
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import logging
 import math
@@ -86,6 +87,7 @@ import time
 
 import numpy as np
 
+from ..analysis import sanitizer as _san
 from ..fault import injection as _inj
 from ..fault import watchdog as _wd
 from ..framework import core as _fcore
@@ -331,6 +333,13 @@ class ContinuousBatchingEngine:
             self._decode_fn = jit.to_static(self._decode_body)
             self._prefill_fn = jit.to_static(self._prefill_body)
         self._key = to_tensor(np.asarray(jax.random.PRNGKey(int(seed))))
+
+        # runtime-sanitizer bookkeeping: after warmup() the scheduler tick
+        # runs inside a steady_state region (every fresh trace/compile/sync
+        # in it is a finding); buckets traced so far are tracked so the
+        # legitimate over-bucket growth path can declare itself allowed
+        self._warmed = False
+        self._warm_buckets = set()
 
         # host-side slot table — mutated only under _mu, by the scheduler
         # generation that owns the engine (restart supersedes via _gen)
@@ -661,6 +670,7 @@ class ContinuousBatchingEngine:
             # all-zero tables aim every warmup write at scratch page 0
             zero_row = to_tensor(np.zeros(self.pages_per_seq, np.int32))
             for b in self.prefill_buckets:
+                # analysis: allow GRAFT010 — warmup runs before the scheduler thread exists; steady-state _key writes hold _mu
                 _, self._key = self._prefill_fn(
                     to_tensor(np.zeros((1, b), np.int32)), zero_row,
                     to_tensor(np.int32(b)), to_tensor(np.float32(0.0)),
@@ -684,6 +694,9 @@ class ContinuousBatchingEngine:
                 self._key,
                 to_tensor(np.zeros((self.slots, self.pages_per_seq), np.int32)),
             )
+            with self._mu:
+                self._warm_buckets = set(self.prefill_buckets)
+            self._warmed = True
             return self
         for b in self.prefill_buckets:
             _, self._key = self._prefill_fn(
@@ -699,6 +712,9 @@ class ContinuousBatchingEngine:
             self._poison_zero,
             self._key,
         )
+        with self._mu:
+            self._warm_buckets = set(self.prefill_buckets)
+        self._warmed = True
         return self
 
     def compile_counts(self):
@@ -786,11 +802,22 @@ class ContinuousBatchingEngine:
         (prefill first-tokens included).  Synchronous alternative to
         start() — never mix the two."""
         gen = self._gen if gen is None else gen
-        self._evict_expired(gen)
-        emitted = self._admit(gen)
-        n = emitted + self._decode_once(gen)
+        # after warmup() the whole tick is a steady-state region: every
+        # compiled body is traced, so any fresh trace/eager compile — and
+        # any host sync outside the declared flush boundaries — is a
+        # sanitizer finding attributed to the line that caused it
+        ctx = (
+            _san.steady_state("serving.engine.step")
+            if self._warmed and _san.enabled()
+            else contextlib.nullcontext()
+        )
+        with ctx:
+            self._evict_expired(gen)
+            emitted = self._admit(gen)
+            n = emitted + self._decode_once(gen)
         if _fcore.flag("FLAGS_serve_debug_invariants"):
             self._check_invariants()
+        # analysis: allow GRAFT010 — liveness stamp: a raced write only delays the watchdog one tick
         self._last_progress = time.monotonic()
         return n
 
@@ -1033,6 +1060,20 @@ class ContinuousBatchingEngine:
 
     # -- internals ----------------------------------------------------------
 
+    @contextlib.contextmanager
+    def _bucket_growth(self, bucket):
+        """Sanctioned fresh trace: an over-bucket prompt grew a new prefill
+        bucket after warmup (one extra compile by design, then cached like
+        any other).  Declares the dispatch allowed to the sanitizer and
+        marks the bucket warmed once it lands."""
+        if not self._warmed or bucket in self._warm_buckets:
+            yield
+            return
+        with _san.allow(f"prefill bucket growth to {bucket}"):
+            yield
+        with self._mu:
+            self._warm_buckets.add(bucket)
+
     def _bucket_for(self, n):
         for b in self.prefill_buckets:
             if n <= b:
@@ -1040,8 +1081,9 @@ class ContinuousBatchingEngine:
         # over-bucket prompt: grow a next-power-of-two bucket (one extra
         # compile, then cached/snapshotted like any other)
         b = min(1 << (n - 1).bit_length(), self.max_len - 1)
-        self.prefill_buckets.append(b)
-        self.prefill_buckets.sort()
+        with self._mu:
+            self.prefill_buckets.append(b)
+            self.prefill_buckets.sort()
         return b
 
     # -- paged-KV allocator ---------------------------------------------------
@@ -1243,11 +1285,13 @@ class ContinuousBatchingEngine:
             # a restart during the hang owns this request now — bail before
             # dispatching a zombie prefill into the (shared) KV pool
             self._check_gen(gen)
-            nxt, key = self._prefill_fn(
-                to_tensor(toks), to_tensor(np.int32(s)), to_tensor(np.int32(L)),
-                to_tensor(np.float32(req.temperature)), key,
-            )
-            tok = int(np.asarray(nxt.numpy()).reshape(-1)[0])
+            with self._bucket_growth(bucket):
+                nxt, key = self._prefill_fn(
+                    to_tensor(toks), to_tensor(np.int32(s)), to_tensor(np.int32(L)),
+                    to_tensor(np.float32(req.temperature)), key,
+                )
+            with _san.allowed_sync("prefill first-token fetch"):
+                tok = int(np.asarray(nxt.numpy()).reshape(-1)[0])
         with self._mu:
             self._check_gen(gen)  # a restart while we dispatched owns req now
             self._key = key
@@ -1344,20 +1388,22 @@ class ContinuousBatchingEngine:
                         to_tensor(np.int32(copy_args[0])),
                         to_tensor(np.int32(copy_args[1])),
                     )
-                if match_len == 0:
-                    nxt, key = self._prefill_fn(
-                        to_tensor(toks), to_tensor(row_table),
-                        to_tensor(np.int32(L)),
-                        to_tensor(np.float32(req.temperature)), key,
-                    )
-                else:
-                    nxt, key = self._chunk_fn(
-                        to_tensor(toks), to_tensor(row_table),
-                        to_tensor(np.int32(suffix)),
-                        to_tensor(np.full(1, match_len, np.int32)),
-                        to_tensor(np.float32(req.temperature)), key,
-                    )
-                tok = int(np.asarray(nxt.numpy()).reshape(-1)[0])
+                with self._bucket_growth(bucket):
+                    if match_len == 0:
+                        nxt, key = self._prefill_fn(
+                            to_tensor(toks), to_tensor(row_table),
+                            to_tensor(np.int32(L)),
+                            to_tensor(np.float32(req.temperature)), key,
+                        )
+                    else:
+                        nxt, key = self._chunk_fn(
+                            to_tensor(toks), to_tensor(row_table),
+                            to_tensor(np.int32(suffix)),
+                            to_tensor(np.full(1, match_len, np.int32)),
+                            to_tensor(np.float32(req.temperature)), key,
+                        )
+                with _san.allowed_sync("prefill first-token fetch"):
+                    tok = int(np.asarray(nxt.numpy()).reshape(-1)[0])
         finally:
             if pinned is not None:
                 with self._mu:
@@ -1471,7 +1517,7 @@ class ContinuousBatchingEngine:
         with self._watchdog.arm(
             "serve.fetch", timeout=self._wd_timeout(),
             context=f"{len(batches)} buffered steps",
-        ):
+        ), _san.allowed_sync("batched decode-token flush"):
             fetched = [
                 (
                     np.asarray(nxt.numpy()).reshape(-1),

@@ -491,6 +491,7 @@ class DataLoader:
         self._epoch = int(state.get("epoch", 0))
         self._resume_skip = int(state.get("batches_consumed", 0))
         self._batches_consumed = self._resume_skip
+        # analysis: allow GRAFT010 — restore runs before the producer thread exists; live updates are a monotonic gauge
         self._prefetch_hwm = int(state.get("prefetch_hwm", 0))
         rng = state.get("rng_state")
         if rng is not None:

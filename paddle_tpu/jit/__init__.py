@@ -42,6 +42,18 @@ _logger = logging.getLogger("paddle_tpu")
 
 _MISS = object()
 
+
+def _sanitizer_note_trace(name):
+    """Report a fresh trace to the runtime sanitizer (no-op unless
+    FLAGS_debug_sanitize is on; inside a steady-state region the trace is
+    a GRAFT020 finding attributed to the user-level caller line)."""
+    try:
+        from ..analysis import sanitizer as _san
+
+        _san.note_trace(name)
+    except Exception:
+        pass
+
 # callables run before each compiled invocation to refresh host-driven state
 # (e.g. optimizer LR from a scheduler) — keyed weakly by owner object.
 _state_refreshers = weakref.WeakKeyDictionary()
@@ -234,6 +246,7 @@ class StaticFunction:
     def _trace(self, key, args, kwargs, bundle=None):
         self.trace_count += 1
         _snap.STATS["traces"] += 1
+        _sanitizer_note_trace(getattr(self._fn, "__name__", "<fn>"))
         t0 = time.perf_counter()
         fn = self._fn
         if bundle is None:

@@ -211,6 +211,18 @@ def save(path, fp, exported_blob, meta):
     return True
 
 
+def _note_snapshot_miss(reason):
+    """A snapshot-tier miss means a full trace+lower+compile follows; in a
+    steady-state sanitizer region that is a GRAFT021 finding attributed to
+    the caller (no-op unless FLAGS_debug_sanitize)."""
+    try:
+        from ..analysis import sanitizer as _san
+
+        _san.note_eager_miss(f"aot-snapshot ({reason})")
+    except Exception:
+        pass
+
+
 def load(path, fp):
     """Return (exported_blob, meta) or None.  Fingerprint mismatches delete
     the stale file (auto-invalidation); corrupt entries fall back silently."""
@@ -222,6 +234,7 @@ def load(path, fp):
                 raw = f.read()
         except OSError:
             STATS["misses"] += 1
+            _note_snapshot_miss("absent")
             return None
     try:
         payload = pickle.loads(raw)
@@ -233,6 +246,7 @@ def load(path, fp):
     except Exception as e:  # torn write, truncation, hostile bytes: all = miss
         STATS["corrupt"] += 1
         STATS["misses"] += 1
+        _note_snapshot_miss("corrupt")
         logger.warning("compile cache: corrupt snapshot %s (%s); recompiling", path, e)
         try:
             os.remove(path)
@@ -242,6 +256,7 @@ def load(path, fp):
     if payload["fingerprint"] != fp:
         STATS["invalidated"] += 1
         STATS["misses"] += 1
+        _note_snapshot_miss("stale fingerprint")
         logger.info("compile cache: stale snapshot %s (version/flags changed); invalidating", path)
         try:
             os.remove(path)

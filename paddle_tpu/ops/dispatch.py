@@ -186,6 +186,14 @@ def _cache_get(key, builder):
     entry = _EAGER_CACHE.get(key)
     if entry is None:
         _EAGER_STATS["misses"] += 1
+        try:
+            from ..analysis import sanitizer as _san
+
+            # a miss in a steady-state region is a GRAFT021 finding: the
+            # eager path is building an executable mid-hot-loop
+            _san.note_eager_miss(str(key[0]) if isinstance(key, tuple) else str(key))
+        except Exception:
+            pass
         entry = builder()
         _EAGER_CACHE[key] = entry
         cap = _eager_cache_cap()

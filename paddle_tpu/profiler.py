@@ -8,6 +8,7 @@ from __future__ import annotations
 import contextlib
 import enum
 import os
+import threading
 import time
 
 import jax
@@ -75,28 +76,39 @@ _step_gauges = {
     "inflight_max": 0,
 }
 
+# One lock for every gauge dict in this module.  The counters are written
+# from the engine scheduler thread, the HTTP front door, the engine
+# supervisor, and the training loop concurrently; +=-on-dict-entry is NOT
+# atomic under free-threading (and only incidentally so under the GIL), so
+# every record/reset/summary takes this lock.  All sections are tiny and
+# allocation-free — the lock never shows up in profiles.
+_counters_lock = threading.Lock()
+
 
 def record_step(dispatch_s=0.0, host_blocked_s=0.0, inflight=0, wall_s=0.0):
     """One training step's host-time split + in-flight ring depth."""
-    g = _step_gauges
-    g["steps"] += 1
-    g["dispatch_s"] += dispatch_s
-    g["host_blocked_s"] += host_blocked_s
-    g["wall_s"] += wall_s
-    g["inflight_sum"] += inflight
-    if inflight > g["inflight_max"]:
-        g["inflight_max"] = inflight
+    with _counters_lock:
+        g = _step_gauges
+        g["steps"] += 1
+        g["dispatch_s"] += dispatch_s
+        g["host_blocked_s"] += host_blocked_s
+        g["wall_s"] += wall_s
+        g["inflight_sum"] += inflight
+        if inflight > g["inflight_max"]:
+            g["inflight_max"] = inflight
 
 
 def reset_step_breakdown():
-    for k in _step_gauges:
-        _step_gauges[k] = 0 if isinstance(_step_gauges[k], int) else 0.0
+    with _counters_lock:
+        for k in _step_gauges:
+            _step_gauges[k] = 0 if isinstance(_step_gauges[k], int) else 0.0
 
 
 def step_breakdown():
     """Aggregated step-time split: host-blocked vs dispatch vs device
     estimate, plus the in-flight-depth gauge (avg/max)."""
-    g = _step_gauges
+    with _counters_lock:
+        g = dict(_step_gauges)
     n = g["steps"]
     out = {"steps": n}
     if not n:
@@ -147,42 +159,46 @@ _SERVING_FAULT_KINDS = (
 def record_serving_fault(kind, n=1):
     """Count one serving fault-domain event (see _SERVING_FAULT_KINDS;
     unknown kinds are counted too so call sites never have to guard)."""
-    f = _serving_gauges["faults"]
-    f[kind] = f.get(kind, 0) + int(n)
+    with _counters_lock:
+        f = _serving_gauges["faults"]
+        f[kind] = f.get(kind, 0) + int(n)
 
 
 def record_serving_request(ttft_s, tokens, wall_s):
     """One finished generation request: time-to-first-token, tokens emitted,
     submit->finish wall time."""
-    g = _serving_gauges
-    g["requests"] += 1
-    g["tokens"] += int(tokens)
-    g["ttfts_s"].append(float(ttft_s))
-    if len(g["ttfts_s"]) > _TTFT_KEEP:
-        del g["ttfts_s"][: -_TTFT_KEEP]
+    with _counters_lock:
+        g = _serving_gauges
+        g["requests"] += 1
+        g["tokens"] += int(tokens)
+        g["ttfts_s"].append(float(ttft_s))
+        if len(g["ttfts_s"]) > _TTFT_KEEP:
+            del g["ttfts_s"][: -_TTFT_KEEP]
 
 
 def record_serving_tick(occupancy, queue_depth, busy_s=0.0):
     """One engine decode step: fraction of slots active, queued requests,
     and the step's wall time (summed into the busy window for tokens/s)."""
-    g = _serving_gauges
-    g["ticks"] += 1
-    g["occupancy_sum"] += float(occupancy)
-    if occupancy > g["occupancy_peak"]:
-        g["occupancy_peak"] = float(occupancy)
-    g["queue_depth_sum"] += int(queue_depth)
-    g["busy_s"] += float(busy_s)
-    if queue_depth > g["queue_depth_max"]:
-        g["queue_depth_max"] = int(queue_depth)
+    with _counters_lock:
+        g = _serving_gauges
+        g["ticks"] += 1
+        g["occupancy_sum"] += float(occupancy)
+        if occupancy > g["occupancy_peak"]:
+            g["occupancy_peak"] = float(occupancy)
+        g["queue_depth_sum"] += int(queue_depth)
+        g["busy_s"] += float(busy_s)
+        if queue_depth > g["queue_depth_max"]:
+            g["queue_depth_max"] = int(queue_depth)
 
 
 def reset_serving():
-    g = _serving_gauges
-    g.update(
-        requests=0, tokens=0, ttfts_s=[], busy_s=0.0, ticks=0,
-        occupancy_sum=0.0, occupancy_peak=0.0, queue_depth_sum=0,
-        queue_depth_max=0, faults={},
-    )
+    with _counters_lock:
+        g = _serving_gauges
+        g.update(
+            requests=0, tokens=0, ttfts_s=[], busy_s=0.0, ticks=0,
+            occupancy_sum=0.0, occupancy_peak=0.0, queue_depth_sum=0,
+            queue_depth_max=0, faults={},
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -214,56 +230,64 @@ _flash_fallbacks = {}  # reason -> count of Pallas-ineligible compilations
 def record_flash_fallback(reason):
     """One flash-attention dispatch that fell back from the Pallas kernel to
     the XLA blockwise path; counted per compiled shape, keyed by reason."""
-    _flash_fallbacks[reason] = _flash_fallbacks.get(reason, 0) + 1
+    with _counters_lock:
+        _flash_fallbacks[reason] = _flash_fallbacks.get(reason, 0) + 1
 
 
 def flash_fallback_summary():
-    return dict(_flash_fallbacks)
+    with _counters_lock:
+        return dict(_flash_fallbacks)
 
 
 def reset_flash_fallbacks():
-    _flash_fallbacks.clear()
+    with _counters_lock:
+        _flash_fallbacks.clear()
 
 
 def record_prefix_lookup(hit, tokens_saved=0, cow_copies=0):
     """One admission-time prefix-cache lookup: whether any cached prefix was
     reused, how many prompt tokens skipped prefill, and how many shared
     pages were copy-on-written for the new reader."""
-    g = _paging_gauges
-    if hit:
-        g["prefix_hits"] += 1
-        g["prefill_tokens_saved"] += int(tokens_saved)
-        g["cow_copies"] += int(cow_copies)
-    else:
-        g["prefix_misses"] += 1
+    with _counters_lock:
+        g = _paging_gauges
+        if hit:
+            g["prefix_hits"] += 1
+            g["prefill_tokens_saved"] += int(tokens_saved)
+            g["cow_copies"] += int(cow_copies)
+        else:
+            g["prefix_misses"] += 1
 
 
 def record_paging_event(kind, n=1):
     """Count an allocator event: 'cache_evictions' or 'cache_commits'."""
-    g = _paging_gauges
-    g[kind] = g.get(kind, 0) + int(n)
+    with _counters_lock:
+        g = _paging_gauges
+        g[kind] = g.get(kind, 0) + int(n)
 
 
 def record_paging_tick(pages_used, pages_total):
     """One engine step's page-pool occupancy snapshot."""
-    g = _paging_gauges
-    g["ticks"] += 1
-    g["pages_used_sum"] += int(pages_used)
-    g["pages_total"] = int(pages_total)
-    if pages_used > g["pages_used_peak"]:
-        g["pages_used_peak"] = int(pages_used)
+    with _counters_lock:
+        g = _paging_gauges
+        g["ticks"] += 1
+        g["pages_used_sum"] += int(pages_used)
+        g["pages_total"] = int(pages_total)
+        if pages_used > g["pages_used_peak"]:
+            g["pages_used_peak"] = int(pages_used)
 
 
 def reset_paging():
-    g = _paging_gauges
-    for k in g:
-        g[k] = 0
+    with _counters_lock:
+        g = _paging_gauges
+        for k in g:
+            g[k] = 0
 
 
 def paging_summary():
     """Aggregated paged-KV metrics: prefix hit rate, prefill tokens saved,
     COW copies, cache churn, and mean/peak page occupancy."""
-    g = _paging_gauges
+    with _counters_lock:
+        g = dict(_paging_gauges)
     out = {}
     lookups = g["prefix_hits"] + g["prefix_misses"]
     if lookups:
@@ -293,7 +317,10 @@ def _pctl(sorted_vals, q):
 def serving_summary():
     """Aggregated serving metrics: requests, tokens, aggregate tokens/s over
     the busy window, TTFT p50/p95, mean slot occupancy, queue depth avg/max."""
-    g = _serving_gauges
+    with _counters_lock:
+        g = dict(_serving_gauges)
+        g["ttfts_s"] = list(g["ttfts_s"])
+        g["faults"] = dict(g["faults"])
     out = {"requests": g["requests"], "tokens": g["tokens"]}
     if g["busy_s"] > 0:
         out["tokens_per_s"] = g["tokens"] / g["busy_s"]
@@ -468,6 +495,17 @@ class Profiler:
                 "flash fallbacks: "
                 + "  ".join(f"{k} {v}" for k, v in sorted(fb.items()))
             )
+        # the runtime sanitizer's verdict rides along: unexpected traces/
+        # compiles/syncs in steady-state regions, each attributed to the
+        # user-level line that caused it (FLAGS_debug_sanitize)
+        try:
+            from .analysis import sanitizer as _san
+
+            rep = _san.report()
+            if rep:
+                print(rep)
+        except Exception:
+            pass
         # compile caches dominate cold-start cost: surface them next to the
         # step timing so "why was the first step slow" is answerable here
         try:

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from . import framework
+from .analysis import sanitizer as _sanitizer
 from .framework import core as _core
 
 
@@ -187,6 +188,13 @@ class Tensor:
                 "Tensor.numpy() is not allowed inside a @to_static traced function; "
                 "return the tensor instead or compute on device."
             )
+        # runtime sanitizer: a device->host fetch inside a steady-state
+        # region (serving scheduler, in-flight ring) is a GRAFT022 finding
+        # unless wrapped in sanitizer.allowed_sync(...).  zone_active() is
+        # one thread-local read, so the common (unsanitized) path pays
+        # nothing measurable.
+        if _sanitizer.zone_active():
+            _sanitizer.note_host_sync("Tensor.numpy")
         return np.asarray(arr)
 
     def item(self, *args):

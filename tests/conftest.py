@@ -31,6 +31,34 @@ def _seeded():
     _core.set_active_amp(None)
 
 
+# the serving/async suites run under the runtime sanitizer: any unexpected
+# trace/compile/host-sync inside a steady-state region is a hard test error
+_SANITIZED_MODULES = {
+    "test_serving_engine",
+    "test_paged_kv",
+    "test_serving_fault",
+    "test_async_pipeline",
+}
+
+
+@pytest.fixture(autouse=True)
+def _sanitized(request):
+    if request.module.__name__ not in _SANITIZED_MODULES:
+        yield
+        return
+    from paddle_tpu.analysis import sanitizer
+    from paddle_tpu.framework import core as _core
+
+    _core.set_flags({"FLAGS_debug_sanitize": True})
+    sanitizer.reset()
+    try:
+        yield
+        sanitizer.check()
+    finally:
+        sanitizer.reset()
+        _core.set_flags({"FLAGS_debug_sanitize": False})
+
+
 def finite_difference_grad(fn, x, eps=1e-3):
     """Numeric gradient of scalar fn at numpy array x (OpTest check_grad)."""
     x = np.asarray(x, np.float64)

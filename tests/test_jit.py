@@ -148,6 +148,8 @@ class TestToStatic:
     def test_numpy_inside_trace_raises(self):
         @paddle.jit.to_static
         def f(x):
+            # analysis: allow GRAFT002 — deliberate hazard: float() on a traced value is the point
+            # analysis: allow GRAFT003 — deliberate hazard: this test asserts the runtime error
             return float(x.numpy().sum())
 
         with pytest.raises(Exception):
@@ -162,6 +164,7 @@ class TestControlFlow:
     def test_tensor_bool_inside_trace_raises_actionable(self):
         @paddle.jit.to_static
         def f(x):
+            # analysis: allow GRAFT001 — deliberate hazard: asserts the actionable TypeError
             if x.sum() > 0:
                 return x + 1
             return x - 1

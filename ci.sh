@@ -2,22 +2,29 @@
 # Single build+test entry (reference: paddle/scripts/paddle_build.sh —
 # SURVEY.md §2.4 "CI entry").  Builds the native core, runs its gtest,
 # then the full Python suite on the 8-device CPU-sim mesh, and finally a
-# CPU smoke of the benchmark matrix.  Usage: ./ci.sh [fast|chaos|chaos-serve]
-#   fast        — skip slow tests, stop at first failure
-#   chaos       — ONLY the slow-marked fault-domain drills (gang restart,
-#                 heartbeat eviction, full restart-resume), each run under a
-#                 hard external timeout so a broken watchdog cannot wedge CI
-#   chaos-serve — the SERVING fault-domain drills (prefill hang -> watchdog
-#                 -> warm restart, NaN isolation, SIGTERM drain, deadline
-#                 eviction), slow HTTP drill included, under a hard timeout
+# CPU smoke of the benchmark matrix.  Usage:
+#   ./ci.sh [fast|chaos|chaos-serve|chaos-router]
+#   fast         — skip slow tests, stop at first failure
+#   chaos        — ONLY the slow-marked fault-domain drills (gang restart,
+#                  heartbeat eviction, full restart-resume), each run under a
+#                  hard external timeout so a broken watchdog cannot wedge CI
+#   chaos-serve  — the SERVING fault-domain drills (prefill hang -> watchdog
+#                  -> warm restart, NaN isolation, SIGTERM drain, deadline
+#                  eviction), slow HTTP drill included, under a hard timeout
+#   chaos-router — the MULTI-REPLICA router drills (ISSUE 9): 2 replicas,
+#                  injected probe flap + kill -9 under Poisson load, breaker
+#                  cycle, rolling drain — exactly-once resolution end to end
 set -euo pipefail
 cd "$(dirname "$0")"
 
 MODE="${1:-}"
-if [ -n "$MODE" ] && [ "$MODE" != "fast" ] && [ "$MODE" != "chaos" ] && [ "$MODE" != "chaos-serve" ]; then
-  echo "usage: ./ci.sh [fast|chaos|chaos-serve]" >&2
-  exit 2
-fi
+case "${MODE:-}" in
+  ""|fast|chaos|chaos-serve|chaos-router) ;;
+  *)
+    echo "usage: ./ci.sh [fast|chaos|chaos-serve|chaos-router]" >&2
+    exit 2
+    ;;
+esac
 
 echo "== static analysis (trace-purity + concurrency lint, GRAFT0xx) =="
 # the cheapest gate runs first in EVERY tier: pure-AST, no accelerator,
@@ -42,6 +49,21 @@ if [ "$MODE" = "chaos-serve" ]; then
       "tests/test_paged_kv.py::test_warm_restart_preserves_prefix_cache_no_recompile" \
       -q -p no:cacheprovider
   echo "CHAOS-SERVE OK"
+  exit 0
+fi
+
+if [ "$MODE" = "chaos-router" ]; then
+  echo "== router chaos suite (2-replica failover drills + kill -9 drill, hard 15min cap) =="
+  # the whole router file: probe flap -> breaker open/half-open/close,
+  # mid-stream replica death -> exactly-once failover, rolling drain with
+  # zero drops, and the slow drill — kill -9 of one subprocess replica
+  # under Poisson load, survivor outputs bit-identical, Container respawn.
+  # timeout(1) is the layer above the router's own deadlines: a wedged
+  # replica boot or probe loop must fail CI, not hang it
+  timeout -k 30 900 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python -m pytest tests/test_serving_router.py \
+      -q -p no:cacheprovider
+  echo "CHAOS-ROUTER OK"
   exit 0
 fi
 
@@ -123,6 +145,19 @@ SERVE_FAULT_TESTS=(tests/test_serving_fault.py::test_prefill_hang_watchdog_resta
 [ "$MODE" != "fast" ] && SERVE_FAULT_TESTS=(tests/test_serving_fault.py)
 timeout -k 30 600 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python -m pytest "${SERVE_FAULT_TESTS[@]}" -q -m "not slow" -p no:cacheprovider
+
+echo "== router smoke (ISSUE 9 acceptance subset) =="
+# both tiers run the deterministic core of the router contract: mid-stream
+# replica death fails over with bit-identical outputs, the breaker walks
+# its full open/half-open/close cycle, and two-hop deadline propagation
+# shrinks the budget the engine sees; fast mode runs that trio, full mode
+# the whole non-slow file (the kill -9 drill lives in chaos-router)
+ROUTER_TESTS=(tests/test_serving_router.py::test_failover_retries_on_survivor_bit_identical
+              tests/test_serving_router.py::test_breaker_open_half_open_close_cycle
+              tests/test_serving_router.py::test_two_hop_deadline_propagation_shrinks_budget)
+[ "$MODE" != "fast" ] && ROUTER_TESTS=(tests/test_serving_router.py)
+timeout -k 30 600 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python -m pytest "${ROUTER_TESTS[@]}" -q -m "not slow" -p no:cacheprovider
 
 if [ "$MODE" != "fast" ]; then
   echo "== bench smoke (CPU) =="

@@ -52,6 +52,7 @@ from . import version  # noqa: F401
 from .version import full_version as __version__  # noqa: F401
 from . import static
 from . import inference
+from . import serving  # noqa: F401  (multi-replica router + failover)
 from . import fault  # noqa: F401  (fault injection + supervised recovery)
 from .framework.io import save, load  # noqa: F401
 from .jit import to_static  # noqa: F401

@@ -207,6 +207,30 @@ class Container:
                 self.proc.kill()
         self.close_log()
 
+    def kill9(self):
+        """SIGKILL with no grace (chaos drills: the process vanishes
+        mid-request, exactly like an OOM kill or node loss)."""
+        if self.proc and self.proc.poll() is None:
+            try:
+                self.proc.kill()
+            except OSError:
+                pass
+
+    def restart(self, grace=10.0):
+        """Rolling-restart hook (serving router, elastic controller):
+        SIGTERM -> wait up to `grace` for a clean drain -> SIGKILL the
+        stragglers -> respawn with the same env contract and a fresh log.
+        Returns the new Popen; the caller gates re-admission on /healthz."""
+        self.signal_stop()
+        if self.proc is not None:
+            try:
+                self.proc.wait(grace)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(10)
+        self.close_log()
+        return self.start()
+
 
 class CollectiveController:
     """Reference: launch/controllers/collective.py watch loop +

@@ -222,3 +222,6 @@ register("supervisor.step", "fires inside Supervisor.after_step")
 register("serve.prefill.hang", "HANGS the serving engine's prefill dispatch (watchdog -> engine restart drill)")
 register("serve.decode.nan", "poisons ONE active slot's decode logits with NaN for one step (as traced data)")
 register("serve.loop.crash", "crashes the engine scheduler thread (EngineSupervisor restart drill)")
+register("router.replica.hang", "HANGS the router's dispatch to one replica (wedged connection drill; bounded by the HTTP timeout)")
+register("router.replica.flap", "fails the router's /healthz probe of a replica (flapping-replica / breaker drill)")
+register("router.replica.kill", "SIGKILLs a router-managed replica process at probe time (kill -9 chaos drill)")

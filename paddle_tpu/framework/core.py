@@ -493,6 +493,51 @@ define_flag(
     "unshared suffix",
 )
 define_flag(
+    "FLAGS_router_probe_interval", 0.25,
+    "serving router: seconds between /healthz probes of each registered "
+    "replica (drives live/ready/draining/dead tracking and load gauges)",
+)
+define_flag(
+    "FLAGS_router_probe_timeout", 2.0,
+    "serving router: per-probe HTTP timeout (s); a timed-out probe counts "
+    "as a replica failure toward the circuit breaker",
+)
+define_flag(
+    "FLAGS_router_max_retries", 3,
+    "serving router: retry budget per request — connect failures, 503s, and "
+    "retriable 504s fail over to another replica with jittered exponential "
+    "backoff up to this many extra attempts (0 disables failover)",
+)
+define_flag(
+    "FLAGS_router_retry_backoff", 0.05,
+    "serving router: initial retry delay (s), doubled per attempt with "
+    "+/-50% jitter; always clamped by the request's remaining deadline",
+)
+define_flag(
+    "FLAGS_router_breaker_threshold", 3,
+    "serving router: consecutive replica failures that trip its circuit "
+    "breaker open (closed -> open -> half-open probe -> closed)",
+)
+define_flag(
+    "FLAGS_router_breaker_cooldown", 1.0,
+    "serving router: seconds an open circuit breaker waits before letting "
+    "ONE half-open trial request through; success closes it, failure "
+    "re-opens for another cooldown",
+)
+define_flag(
+    "FLAGS_router_max_inflight", 64,
+    "serving router: bounded admission — requests in flight through the "
+    "router beyond this are shed with 503 + Retry-After from the healthiest "
+    "replica's drain estimate (brownout)",
+)
+define_flag(
+    "FLAGS_router_hedge_s", 0.0,
+    "serving router: hedged dispatch delay (s) — a zero-token request still "
+    "unanswered after this long is duplicated onto a second replica and the "
+    "first completed response wins (pure generation makes the duplicate "
+    "safe).  0 disables hedging.",
+)
+define_flag(
     "FLAGS_debug_sanitize", False,
     "runtime trace/sync sanitizer (paddle_tpu.analysis.sanitizer): count "
     "every fresh trace, eager-cache miss, and device->host sync; inside a "

@@ -236,16 +236,30 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
             self.end_headers()
             self.wfile.write(body)
 
-        def _busy(self, msg="admission queue full, retry later", retry_after=None):
+        def _reply_error(self, code, err_type, msg, retriable, retry_after=None):
+            # uniformly typed error JSON: the router's retry decision is
+            # driven by `retriable` + Retry-After, never by string matching
+            headers = {}
+            if retry_after:
+                headers["Retry-After"] = str(max(1, int(retry_after + 0.5)))
+            self._reply(
+                code,
+                {
+                    "error": msg,
+                    "type": err_type,
+                    "retriable": bool(retriable),
+                    "retry_after_s": retry_after or 0,
+                },
+                headers,
+            )
+
+        def _busy(self, msg="admission queue full, retry later",
+                  retry_after=None, err_type="EngineUnavailable"):
             # Retry-After from the queue-drain estimate: a shed client
             # retries when a slot is plausibly free, not immediately
             if retry_after is None and engine is not None:
                 retry_after = engine.estimate_drain_s()
-            headers = {}
-            if retry_after:
-                headers["Retry-After"] = str(max(1, int(retry_after + 0.5)))
-            self._reply(503, {"error": msg, "retry_after_s": retry_after or 0},
-                        headers)
+            self._reply_error(503, err_type, msg, True, retry_after)
 
         def _healthz(self):
             if engine is not None:
@@ -265,11 +279,30 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
             else:
                 self._reply(404, {"error": "use POST /predict"})
 
+        def _deadline_s(self, req):
+            # per-request deadline: body field, else the router's
+            # X-Deadline-Ms hop header (remaining budget at send time)
+            d = req.get("deadline_s")
+            if d is not None:
+                return float(d)
+            hdr = self.headers.get("X-Deadline-Ms")
+            if hdr is not None:
+                return float(hdr) / 1e3
+            return None
+
         def _generate_engine(self):
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n))
                 ids = req["input_ids"]
+                deadline_s = self._deadline_s(req)
+                if deadline_s is not None and deadline_s <= 0:
+                    # the hop budget was spent in flight; don't even admit
+                    self._reply_error(
+                        504, "DeadlineExceeded",
+                        "deadline exhausted before admission", False,
+                    )
+                    return
                 rows = ids if ids and isinstance(ids[0], list) else [ids]
                 handles = []
                 try:
@@ -280,14 +313,22 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
                                 max_new_tokens=int(req.get("max_new_tokens") or 32),
                                 temperature=float(req.get("temperature", 0.0)),
                                 eos_token_id=req.get("eos_token_id"),
-                                deadline_s=req.get("deadline_s"),
+                                deadline_s=deadline_s,
                             )
                         )
+                except engine_mod.DeadlineUnattainable as e:
+                    # 504 but retriable: a LESS LOADED replica may still
+                    # meet the deadline — the router fails over on this
+                    self._reply_error(
+                        504, type(e).__name__, str(e), True, e.retry_after_s
+                    )
+                    return
                 except EngineUnavailable as e:
-                    # queue full / draining / unattainable deadline / dead:
-                    # rows already admitted still complete server-side; the
-                    # client sheds and retries the whole batch
-                    self._busy(str(e), retry_after=e.retry_after_s)
+                    # queue full / draining / dead: rows already admitted
+                    # still complete server-side; the client sheds and
+                    # retries the whole batch
+                    self._busy(str(e), retry_after=e.retry_after_s,
+                               err_type=type(e).__name__)
                     return
                 outs = [h.wait(timeout=600).tolist() for h in handles]
                 self._reply(
@@ -296,16 +337,23 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
                 )
             except engine_mod.EngineRestarted as e:
                 # in-flight state was lost to a warm restart: typed 503,
-                # the request is safe to retry
-                self._busy(f"{type(e).__name__}: {e}")
+                # the request is safe to retry (no tokens were delivered)
+                self._busy(str(e), err_type=type(e).__name__)
             except engine_mod.DeadlineExceeded as e:
-                self._reply(504, {"error": f"{type(e).__name__}: {e}"})
+                # the deadline passed while queued/decoding: retrying the
+                # same budget elsewhere cannot succeed
+                self._reply_error(504, type(e).__name__, str(e), False)
+            except engine_mod.NonFiniteLogits as e:
+                self._reply_error(500, type(e).__name__, str(e), False)
             except Exception as e:
-                self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                self._reply_error(
+                    400, type(e).__name__, f"{type(e).__name__}: {e}", False
+                )
 
         def do_POST(self):
             if state["draining"]:
-                self._busy("server draining, retry elsewhere")
+                self._busy("server draining, retry elsewhere",
+                           err_type="Draining")
                 return
             if self.path == "/generate" and engine is not None:
                 self._generate_engine()

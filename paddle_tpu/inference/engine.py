@@ -624,7 +624,7 @@ class ContinuousBatchingEngine:
                     f"request needs {need} KV pages (prompt {ids.size} + "
                     f"max_new {max_new_tokens} at page size {self.page_size})"
                     f" but the pool holds {self._pool.usable_pages}",
-                    retry_after_s=self.estimate_drain_s(),
+                    retry_after_s=self._shed_retry_after(deadline_s),
                 )
         req = EngineRequest(
             next(self._req_ids), ids, max_new_tokens, temperature,
@@ -638,7 +638,7 @@ class ContinuousBatchingEngine:
         except queue.Full:
             raise QueueFull(
                 f"admission queue full ({self.queue_depth} pending)",
-                retry_after_s=self.estimate_drain_s(),
+                retry_after_s=self._shed_retry_after(deadline_s),
             ) from None
         with self._mu:
             self._queued_new_tokens += req.max_new_tokens
@@ -769,11 +769,25 @@ class ContinuousBatchingEngine:
             return 0.0
         return math.ceil((active + queued) / max(1, self.slots)) * ew
 
+    def _shed_retry_after(self, deadline_s):
+        """Retry-After for a QueueFull shed: the drain estimate, clamped by
+        the request's own deadline — a client must never be told to retry
+        after its deadline has already passed.  (DeadlineUnattainable keeps
+        the raw estimate on purpose: there the whole point is telling the
+        client WHEN the backlog clears, which is past its deadline.)"""
+        est = self.estimate_drain_s()
+        if deadline_s is not None:
+            return min(est, float(deadline_s))
+        return est
+
     def healthz(self):
         """Liveness/readiness snapshot for serve()'s /healthz: live (engine
         exists, scheduler not running), ready (scheduler thread alive),
         draining, or dead (restart budget exhausted) — plus occupancy,
-        queue depth, restart count, and the queue-drain estimate."""
+        queue depth, restart count, and the queue-drain estimate.  Also
+        carries the load signals a fleet router needs to pick a replica:
+        page-pool free fraction (dense engines report free slot fraction),
+        prefix-cache size, and the EWMA decode-round wall time."""
         t = self._thread
         if self._dead:
             status = "dead"
@@ -783,6 +797,12 @@ class ContinuousBatchingEngine:
             status = "ready"
         else:
             status = "live"
+        if self.paged:
+            usable = max(1, self._pool.usable_pages)
+            page_free = self._pool.free_count() / usable
+        else:
+            page_free = (self.slots - self.active_slots) / self.slots
+        ew = self._step_ewma_s
         return {
             "status": status,
             "slots": self.slots,
@@ -791,6 +811,9 @@ class ContinuousBatchingEngine:
             "queue_depth": self.pending,
             "restarts": self.restart_count,
             "drain_estimate_s": round(self.estimate_drain_s(), 3),
+            "page_free_frac": round(page_free, 4),
+            "prefix_cache_size": len(self._prefix) if self._prefix is not None else 0,
+            "decode_ewma_ms": round(ew * 1e3, 3) if ew else 0.0,
         }
 
     # -- scheduler ----------------------------------------------------------

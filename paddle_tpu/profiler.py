@@ -307,6 +307,60 @@ def paging_summary():
     return out
 
 
+# ---------------------------------------------------------------------------
+# Router gauges (ISSUE 9): the multi-replica serving router counts every
+# routed request, retry/failover, breaker transition, hedge, and brownout
+# shed, plus a per-replica state snapshot — so "which replica is sick and
+# how much traffic moved" is answerable from profiler.summary().
+# ---------------------------------------------------------------------------
+
+_router_gauges = {
+    "requests": 0,
+    "retries": 0,
+    "failovers": 0,
+    "breaker_trips": 0,
+    "breaker_half_open": 0,
+    "breaker_closes": 0,
+    "hedges": 0,
+    "hedge_wins": 0,
+    "brownout_sheds": 0,
+    "deadline_sheds": 0,
+    "no_replica": 0,
+    "replica_states": {},  # replica id -> last observed state string
+}
+
+
+def record_router_event(kind, n=1):
+    """Count one router event: 'requests', 'retries', 'failovers',
+    'breaker_trips', 'breaker_half_open', 'breaker_closes', 'hedges',
+    'hedge_wins', 'brownout_sheds', 'deadline_sheds', 'no_replica'
+    (unknown kinds are counted too so call sites never have to guard)."""
+    with _counters_lock:
+        g = _router_gauges
+        g[kind] = g.get(kind, 0) + int(n)
+
+
+def record_router_replica_state(replica_id, state):
+    """Latest observed state of one replica (ready/draining/dead/...)."""
+    with _counters_lock:
+        _router_gauges["replica_states"][str(replica_id)] = str(state)
+
+
+def reset_router():
+    with _counters_lock:
+        g = _router_gauges
+        for k in g:
+            g[k] = {} if k == "replica_states" else 0
+
+
+def router_summary():
+    """Router counters + the per-replica state snapshot."""
+    with _counters_lock:
+        g = dict(_router_gauges)
+        g["replica_states"] = dict(g["replica_states"])
+    return g
+
+
 def _pctl(sorted_vals, q):
     if not sorted_vals:
         return 0.0
@@ -475,6 +529,23 @@ class Profiler:
                 "serving faults: "
                 + "  ".join(f"{k} {v}" for k, v in sorted(sv["faults"].items()))
             )
+        rt = router_summary()
+        if rt["requests"] or rt["replica_states"]:
+            print(
+                "router: {req} requests  retries {rt}  failovers {fo}"
+                "  breaker trips {bt}  hedges {hg}  brownout sheds {bs}".format(
+                    req=rt["requests"], rt=rt["retries"], fo=rt["failovers"],
+                    bt=rt["breaker_trips"], hg=rt["hedges"],
+                    bs=rt["brownout_sheds"],
+                )
+            )
+            if rt["replica_states"]:
+                print(
+                    "router replicas: "
+                    + "  ".join(
+                        f"{k}={v}" for k, v in sorted(rt["replica_states"].items())
+                    )
+                )
         pg = paging_summary()
         if pg.get("prefix_lookups"):
             print(

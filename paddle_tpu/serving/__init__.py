@@ -1,0 +1,38 @@
+"""Multi-replica serving layer (ISSUE 9): a front-end router over N
+engine replicas with health-checked failover, deadline propagation,
+per-replica circuit breakers, brownout shedding, and rolling
+drain/restart orchestration.  See router.py for the routing contract and
+replica.py for the replica handle / managed worker process.
+
+The heavy pieces load lazily: importing `paddle_tpu.serving` must not pull
+the model stack (mirrors inference/__init__'s engine export pattern).
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "Router",
+    "RouterError",
+    "NoReadyReplica",
+    "RouterOverloaded",
+    "DeadlineExhausted",
+    "serve_router",
+    "Replica",
+    "ReplicaProcess",
+    "ReplicaTransportError",
+]
+
+
+def __getattr__(name):
+    if name in (
+        "Router", "RouterError", "NoReadyReplica", "RouterOverloaded",
+        "DeadlineExhausted", "serve_router",
+    ):
+        from . import router as _router
+
+        return getattr(_router, name)
+    if name in ("Replica", "ReplicaProcess", "ReplicaTransportError"):
+        from . import replica as _replica
+
+        return getattr(_replica, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

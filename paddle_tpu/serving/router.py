@@ -1,0 +1,551 @@
+"""Multi-replica serving router (ISSUE 9): health-checked failover,
+deadline propagation, and rolling drain.
+
+One `Router` owns a registry of N engine replicas (each a `serve()`
+instance, optionally a router-managed `ReplicaProcess`).  A probe thread
+GETs every replica's `/healthz` on `FLAGS_router_probe_interval`, tracking
+live/ready/draining/dead plus the load gauges the engine exports (queue
+depth, drain estimate, page-pool free fraction, EWMA decode step time).
+
+Routing contract:
+
+- **Bounded admission**: at most `FLAGS_router_max_inflight` requests in
+  flight through the router; beyond that, brownout — shed with 503 and a
+  `Retry-After` derived from the HEALTHIEST replica's drain estimate
+  (clamped by the request's own deadline).
+- **Deadline propagation**: the client's `X-Deadline-Ms` (or body
+  `deadline_s`) becomes an absolute deadline at arrival; every hop forwards
+  only the REMAINING budget, so a downstream `DeadlineUnattainable` stays
+  meaningful and a spent budget 504s without touching a replica.  A
+  deadline'd request that no ready replica can meet (drain estimates all
+  exceed the remaining budget) is shed FIRST — over-deadline work never
+  queues behind feasible work.
+- **Failover, exactly-once**: on connect failure, 503, or a retriable
+  typed error (`EngineRestarted`, `DeadlineUnattainable` — a less-loaded
+  replica may still meet it), ZERO-TOKEN requests retry on another replica
+  with jittered exponential backoff, bounded by `FLAGS_router_max_retries`
+  and the remaining deadline.  The retry decision is header/field-driven
+  (`retriable` + `Retry-After` from serve()'s typed error JSON), never
+  string-matched.  Once response bytes have crossed (a token-bearing
+  stream), the request fails typed (`UpstreamIncomplete`, 502,
+  retriable=false) — a retry could double-deliver.
+- **Circuit breaker** per replica: closed -> open after
+  `FLAGS_router_breaker_threshold` consecutive failures -> half-open (one
+  trial after `FLAGS_router_breaker_cooldown`) -> closed on success.
+- **Hedging** (off by default): with `FLAGS_router_hedge_s > 0`, a
+  zero-token request still unanswered after the hedge delay is duplicated
+  onto a second replica; the first complete response wins (generation is
+  pure, so the abandoned duplicate is harmless).
+- **Rolling drain/restart**: `rolling_restart()` takes replicas one at a
+  time — admin-drain (router stops picking it), wait for in-flight work to
+  finish up to the grace window, restart through the launch `Container`
+  (SIGTERM -> grace -> SIGKILL -> respawn), and re-admit only after
+  `/healthz` reports ready.  Zero dropped requests: the fleet keeps
+  serving through the survivor(s).
+
+Chaos: `router.replica.hang` wedges one dispatch (bounded by the HTTP
+timeout), `router.replica.flap` fails probes, `router.replica.kill`
+SIGKILLs a managed replica at probe time — all armed through the same
+`FLAGS_fault_inject` registry production uses.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+
+from .. import profiler as _prof
+from ..framework import core as _core
+from .replica import Replica, ReplicaTransportError
+
+
+class RouterError(RuntimeError):
+    """Typed router-level failure (carries its HTTP mapping)."""
+
+    status = 500
+    retriable = False
+
+    def __init__(self, msg, retry_after_s=None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class NoReadyReplica(RouterError):
+    status = 503
+    retriable = True
+
+
+class RouterOverloaded(RouterError):
+    status = 503
+    retriable = True
+
+
+class DeadlineExhausted(RouterError):
+    status = 504
+    retriable = False
+
+
+class Router:
+    """Front-end router over N serve() replicas.  Thread-safe: handler
+    threads call `handle_generate()` concurrently with the probe thread
+    and the rolling-restart orchestrator; router-local mutable state is
+    guarded by `self._mu` (per-replica state lives under each Replica's
+    own lock)."""
+
+    def __init__(self, replicas, probe_interval=None, probe_timeout=None,
+                 max_retries=None, retry_backoff=None, max_inflight=None,
+                 hedge_s=None, seed=0):
+        self.replicas = [
+            r if isinstance(r, Replica) else Replica(f"r{i}", r)
+            for i, r in enumerate(replicas)
+        ]
+        if len({r.rid for r in self.replicas}) != len(self.replicas):
+            raise ValueError("replica ids must be unique")
+        f = _core.flag
+        self.probe_interval = float(
+            probe_interval if probe_interval is not None
+            else f("FLAGS_router_probe_interval"))
+        self.probe_timeout = float(
+            probe_timeout if probe_timeout is not None
+            else f("FLAGS_router_probe_timeout"))
+        self.max_retries = int(
+            max_retries if max_retries is not None
+            else f("FLAGS_router_max_retries"))
+        self.retry_backoff = float(
+            retry_backoff if retry_backoff is not None
+            else f("FLAGS_router_retry_backoff"))
+        self.max_inflight = int(
+            max_inflight if max_inflight is not None
+            else f("FLAGS_router_max_inflight"))
+        self.hedge_s = float(
+            hedge_s if hedge_s is not None else f("FLAGS_router_hedge_s"))
+        self._mu = threading.Lock()
+        self._rng = random.Random(seed)  # jitter; accessed under _mu
+        self._inflight = 0
+        self._stop = threading.Event()
+        self._probe_thread = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        """First probe sweep synchronously (so pick() has state before any
+        traffic), then the background probe loop."""
+        with self._mu:
+            if self._probe_thread is not None:
+                return self
+        self.probe_once()
+        t = threading.Thread(
+            target=self._probe_loop, name="router-probe", daemon=True
+        )
+        with self._mu:
+            self._probe_thread = t
+        t.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        with self._mu:
+            t = self._probe_thread
+        if t is not None:
+            t.join(5)
+
+    def _probe_loop(self):
+        while not self._stop.wait(self.probe_interval):
+            self.probe_once()
+
+    def probe_once(self):
+        """One probe sweep over the registry (the probe thread's body;
+        tests call it inline for deterministic drills)."""
+        from ..fault import injection as _inj
+
+        for rep in self.replicas:
+            if (rep.process is not None
+                    and _inj.should_fire("router.replica.kill", context=rep.rid)):
+                rep.process.kill9()
+            if _inj.should_fire("router.replica.flap", context=rep.rid):
+                rep.note_probe_failure("injected flap")
+            else:
+                rep.probe(timeout=self.probe_timeout)
+            _prof.record_router_replica_state(rep.rid, rep.state)
+
+    # -- selection -----------------------------------------------------------
+
+    def pick(self, exclude=()):
+        """Least-loaded ready replica whose breaker admits traffic: score by
+        (drain estimate, queued+active work, EWMA latency).  Breaker gates
+        are consumed in score order so a half-open trial slot is only spent
+        on the replica actually chosen."""
+        cands = []
+        for i, rep in enumerate(self.replicas):
+            if rep.rid in exclude:
+                continue
+            s = rep.snapshot()
+            if s["state"] != "ready" or s["admin_draining"]:
+                continue
+            cands.append((
+                s["drain_estimate_s"],
+                s["queue_depth"] + s["active_slots"],
+                s["ewma_latency_s"],
+                i,
+                rep,
+            ))
+        for *_, rep in sorted(cands, key=lambda c: c[:4]):
+            if rep.allow():
+                return rep
+        return None
+
+    def _ready_drains(self):
+        return [
+            s["drain_estimate_s"]
+            for s in (rep.snapshot() for rep in self.replicas)
+            if s["state"] == "ready" and not s["admin_draining"]
+        ]
+
+    def healthiest_retry_after(self, default=1.0):
+        """Retry-After for a shed request: the smallest drain estimate over
+        ready replicas (the soonest ANY replica plausibly frees up)."""
+        drains = self._ready_drains()
+        return max(default, min(drains)) if drains else default
+
+    def healthz(self):
+        snaps = [rep.snapshot() for rep in self.replicas]
+        ready = sum(
+            1 for s in snaps if s["state"] == "ready" and not s["admin_draining"]
+        )
+        with self._mu:
+            inflight = self._inflight
+        return {
+            "status": "ready" if ready else "degraded",
+            "ready_replicas": ready,
+            "replicas": snaps,
+            "inflight": inflight,
+        }
+
+    # -- routing -------------------------------------------------------------
+
+    def handle_generate(self, payload, deadline_ms=None):
+        """Route one /generate body.  Returns (status, body, headers);
+        every request resolves exactly once — a success from exactly one
+        replica, or ONE typed error."""
+        _prof.record_router_event("requests")
+        deadline_t = (
+            time.monotonic() + float(deadline_ms) / 1e3
+            if deadline_ms is not None else None
+        )
+        with self._mu:
+            admitted = self._inflight < self.max_inflight
+            if admitted:
+                self._inflight += 1
+        if not admitted:
+            _prof.record_router_event("brownout_sheds")
+            ra = self._clamp_retry_after(self.healthiest_retry_after(), deadline_t)
+            return self._error(
+                503, "RouterOverloaded", "router admission gate full", True, ra
+            )
+        try:
+            return self._dispatch(payload, deadline_t)
+        finally:
+            with self._mu:
+                self._inflight -= 1
+
+    def _dispatch(self, payload, deadline_t):
+        tried = set()
+        attempt = 0
+        prev_rid = None
+        while True:
+            remaining = (
+                None if deadline_t is None else deadline_t - time.monotonic()
+            )
+            if remaining is not None and remaining <= 0:
+                _prof.record_router_event("deadline_sheds")
+                return self._error(
+                    504, "DeadlineExhausted",
+                    "deadline spent before a replica answered", False,
+                )
+            if remaining is not None:
+                # brownout: shed over-deadline work FIRST — when every ready
+                # replica's backlog already exceeds the remaining budget,
+                # queueing this request anywhere only steals capacity from
+                # feasible work
+                drains = self._ready_drains()
+                if drains and min(drains) > remaining:
+                    _prof.record_router_event("brownout_sheds")
+                    return self._error(
+                        504, "DeadlineUnattainable",
+                        f"no replica can meet the deadline (best drain "
+                        f"estimate {min(drains):.2f}s > remaining "
+                        f"{remaining:.2f}s)", False, retry_after=min(drains),
+                    )
+            rep = self.pick(exclude=tried)
+            if rep is None and tried:
+                # every distinct replica was tried; with budget left, allow
+                # a second pass (a restarted replica may be back)
+                tried = set()
+                rep = self.pick()
+            if rep is None:
+                _prof.record_router_event("no_replica")
+                ra = self._clamp_retry_after(
+                    self.healthiest_retry_after(), deadline_t
+                )
+                return self._error(
+                    503, "NoReadyReplica",
+                    "no ready replica (all down, draining, or breaker-open)",
+                    True, ra,
+                )
+            if attempt > 0:
+                _prof.record_router_event("retries")
+                if rep.rid != prev_rid:
+                    _prof.record_router_event("failovers")
+            outcome = self._send_hedged(rep, payload, remaining)
+            status, body, headers, retriable = outcome
+            if status == 200:
+                return 200, body, headers
+            prev_rid = rep.rid
+            tried.add(rep.rid)
+            if not retriable or attempt >= self.max_retries:
+                return status, body, headers
+            delay = self._backoff(attempt)
+            if remaining is not None:
+                remaining = deadline_t - time.monotonic()
+                if remaining <= 0.01:
+                    _prof.record_router_event("deadline_sheds")
+                    return self._error(
+                        504, "DeadlineExhausted",
+                        "deadline spent during failover", False,
+                    )
+                delay = min(delay, remaining / 2)
+            time.sleep(delay)
+            attempt += 1
+
+    def _backoff(self, attempt):
+        """Jittered exponential backoff: base * 2^attempt * U(0.5, 1.5)."""
+        with self._mu:
+            jitter = 0.5 + self._rng.random()
+        return self.retry_backoff * (2 ** attempt) * jitter
+
+    def _send(self, rep, payload, remaining_s):
+        """One dispatch attempt.  Returns (status, body, headers, retriable)
+        and folds the outcome into the replica's breaker/latency state."""
+        try:
+            status, body, headers, latency = rep.post_generate(
+                payload, remaining_s
+            )
+        except ReplicaTransportError as e:
+            rep.record_failure(str(e))
+            if e.response_started:
+                # bytes already reached us: a retry could double-deliver
+                # tokens — fail typed instead (exactly-once)
+                st, bd, hd = self._error(
+                    502, "UpstreamIncomplete",
+                    f"replica {rep.rid} died mid-response: {e}", False,
+                )
+                return st, bd, hd, False
+            st, bd, hd = self._error(
+                503, "ReplicaUnreachable",
+                f"replica {rep.rid} unreachable: {e}", True,
+            )
+            return st, bd, hd, True
+        if status == 200:
+            rep.record_success(latency)
+            return status, body, headers, False
+        # typed upstream error: serve()'s JSON drives the retry decision
+        body = body if isinstance(body, dict) else {}
+        retriable = bool(body.get("retriable", status == 503))
+        err_type = body.get("type", "")
+        if err_type in ("EngineRestarted", "NonFiniteLogits") or status >= 500 and not body:
+            # sick-replica signals feed the breaker; plain overload
+            # (QueueFull, Draining) does not — a busy replica is healthy
+            rep.record_failure(err_type or f"http {status}")
+        else:
+            rep.record_success(latency)
+        return status, body, headers, retriable
+
+    def _send_hedged(self, rep, payload, remaining_s):
+        """Dispatch with optional hedging: when the primary has not answered
+        after `hedge_s`, duplicate the (zero-token, pure) request onto a
+        second replica; the first complete response wins."""
+        if self.hedge_s <= 0:
+            return self._send(rep, payload, remaining_s)
+        results = []
+        results_mu = threading.Lock()
+        first_done = threading.Event()
+
+        def _run(r):
+            out = self._send(r, payload, remaining_s)
+            with results_mu:
+                results.append((out, r))
+            first_done.set()
+
+        t1 = threading.Thread(target=_run, args=(rep,), daemon=True)
+        t1.start()
+        if not first_done.wait(self.hedge_s):
+            alt = self.pick(exclude={rep.rid})
+            if alt is not None:
+                _prof.record_router_event("hedges")
+                t2 = threading.Thread(target=_run, args=(alt,), daemon=True)
+                t2.start()
+        first_done.wait()
+        with results_mu:
+            out, winner = results[0]
+        if winner is not rep and out[0] == 200:
+            _prof.record_router_event("hedge_wins")
+        return out
+
+    # -- rolling drain/restart ----------------------------------------------
+
+    def rolling_restart(self, grace=None, ready_timeout=60.0, restart_fn=None):
+        """Upgrade the fleet with zero dropped requests: one replica at a
+        time, admin-drain -> wait for in-flight completion up to `grace` ->
+        restart (launch Container SIGTERM -> grace -> SIGKILL -> respawn,
+        or an injected `restart_fn(replica, grace)`) -> re-admit only after
+        /healthz reports ready.  Returns a per-replica report."""
+        if grace is None:
+            grace = float(_core.flag("FLAGS_serve_drain_grace"))
+        return [
+            self._restart_one(rep, grace, ready_timeout, restart_fn)
+            for rep in self.replicas
+        ]
+
+    def _restart_one(self, rep, grace, ready_timeout, restart_fn=None):
+        rep.set_admin_draining(True)
+        _prof.record_router_replica_state(rep.rid, "draining")
+        drained = False
+        try:
+            deadline = time.monotonic() + grace
+            while time.monotonic() < deadline:
+                h = rep.probe(timeout=self.probe_timeout)
+                if h is None or (
+                    not h.get("active_slots") and not h.get("queue_depth")
+                ):
+                    drained = True
+                    break
+                time.sleep(0.05)
+            fn = restart_fn
+            if fn is None and rep.process is not None:
+                fn = lambda r, g: r.process.restart(g)  # noqa: E731
+            if fn is not None:
+                _prof.record_router_replica_state(rep.rid, "restarting")
+                fn(rep, grace)
+            ready = False
+            deadline = time.monotonic() + ready_timeout
+            while time.monotonic() < deadline:
+                h = rep.probe(timeout=self.probe_timeout)
+                if h is not None and h.get("status") in ("ready", "live"):
+                    ready = True
+                    break
+                time.sleep(0.05)
+            return {
+                "replica": rep.rid, "drained": drained,
+                "restarted": fn is not None, "ready": ready,
+            }
+        finally:
+            rep.set_admin_draining(False)
+            _prof.record_router_replica_state(rep.rid, rep.state)
+
+    # -- helpers -------------------------------------------------------------
+
+    @staticmethod
+    def _clamp_retry_after(ra, deadline_t):
+        """Never tell a client to retry after its own deadline."""
+        if deadline_t is not None:
+            ra = min(ra, max(0.0, deadline_t - time.monotonic()))
+        return ra
+
+    @staticmethod
+    def _error(status, err_type, msg, retriable, retry_after=None):
+        headers = {}
+        if retry_after:
+            headers["Retry-After"] = str(max(1, int(retry_after + 0.5)))
+        return status, {
+            "error": msg,
+            "type": err_type,
+            "retriable": bool(retriable),
+            "retry_after_s": retry_after or 0,
+        }, headers
+
+
+def serve_router(replicas, port=8900, host="127.0.0.1", block=True, probe=True):
+    """HTTP front door over a Router (mirrors inference.serve()'s shape):
+
+    - GET  /health   -> 200
+    - GET  /healthz  -> fleet snapshot (200 when >= 1 replica is ready)
+    - POST /generate -> routed with failover + deadline propagation; the
+      client's deadline arrives as `X-Deadline-Ms` (or body `deadline_s`),
+      and each upstream hop receives only the remaining budget.
+
+    Returns the ThreadingHTTPServer with `.router` attached; non-blocking
+    callers get a daemon thread running `serve_forever()`.
+    """
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    router = replicas if isinstance(replicas, Router) else Router(replicas)
+    if probe:
+        router.start()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _reply(self, code, payload, headers=None):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/health":
+                self._reply(200, {"status": "ok"})
+            elif self.path == "/healthz":
+                h = router.healthz()
+                self._reply(200 if h["status"] == "ready" else 503, h)
+            else:
+                self._reply(404, {"error": "use POST /generate"})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._reply(404, {"error": "use POST /generate"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n))
+            except Exception as e:
+                self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                return
+            hdr = self.headers.get("X-Deadline-Ms")
+            deadline_ms = float(hdr) if hdr is not None else None
+            if deadline_ms is None and payload.get("deadline_s") is not None:
+                deadline_ms = float(payload["deadline_s"]) * 1e3
+            # the router owns the deadline now: strip the absolute field so
+            # replicas see only the remaining budget via X-Deadline-Ms
+            payload.pop("deadline_s", None)
+            status, body, headers = router.handle_generate(
+                payload, deadline_ms=deadline_ms
+            )
+            self._reply(status, body, headers={
+                k: v for k, v in headers.items()
+                if k.lower() in ("retry-after",)
+            })
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    server.router = router
+
+    def _shutdown():
+        router.stop()
+        server.shutdown()
+
+    server.stop_router = _shutdown
+    if block:
+        try:
+            server.serve_forever()
+        finally:
+            router.stop()
+        return server
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server

@@ -1,0 +1,634 @@
+"""Multi-replica serving router (ISSUE 9): health-checked failover,
+deadline propagation through two hops, circuit-breaker lifecycle, brownout
+shedding, rolling drain with zero dropped requests, and the kill -9 chaos
+drill.
+
+The fast tests run the REAL router over in-process serve() instances that
+share one tiny model (identical weights across replicas is the property
+failover relies on: greedy outputs are bit-identical whichever replica
+answers).  The slow drill runs router-MANAGED subprocess replicas through
+the launch Container — the production process topology — and kills one with
+SIGKILL under Poisson load.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler as prof
+from paddle_tpu.fault import injection as finj
+from paddle_tpu.inference import serve
+from paddle_tpu.inference.engine import ContinuousBatchingEngine, QueueFull
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import Replica, ReplicaProcess, Router, serve_router
+
+
+@pytest.fixture(scope="module")
+def model():
+    np.random.seed(1234)
+    return LlamaForCausalLM(LlamaConfig.tiny())
+
+
+@pytest.fixture(autouse=True)
+def _clean_router_state():
+    prof.reset_router()
+    yield
+    finj.disarm()
+    prof.reset_router()
+    paddle.set_flags({"FLAGS_fault_hang_sec": 3600.0})
+
+
+def _prompt(n, seed=0):
+    return np.random.RandomState(seed).randint(1, 250, size=n).astype(np.int32)
+
+
+def _ref(model, p, n):
+    return model.generate(paddle.to_tensor(p[None]), max_new_tokens=n).numpy()[0]
+
+
+def _replica_server(model, **kw):
+    """One in-process replica: engine + serve() on an ephemeral port."""
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prefill_buckets", [8])
+    kw.setdefault("queue_depth", 16)
+    kw.setdefault("seed", 0)
+    eng = ContinuousBatchingEngine(model, **kw)
+    srv = serve(eng, port=0, block=False, supervise=False, handle_signals=False)
+    port = srv.server_address[1]
+    return srv, eng, f"http://127.0.0.1:{port}"
+
+
+def _stop_server(srv):
+    try:
+        srv.engine.stop()
+    except Exception:
+        pass
+    srv.shutdown()
+    srv.server_close()
+
+
+def _post(url, body, headers=None, timeout=60):
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: engine.healthz() load fields, forwarded by serve()
+# ---------------------------------------------------------------------------
+
+
+def test_healthz_exports_router_load_fields(model):
+    srv, eng, url = _replica_server(model)
+    try:
+        h = eng.healthz()
+        for k in ("page_free_frac", "prefix_cache_size", "decode_ewma_ms"):
+            assert k in h
+        assert 0.0 <= h["page_free_frac"] <= 1.0
+        # serve() forwards the engine dict verbatim over /healthz
+        with urllib.request.urlopen(url + "/healthz", timeout=5) as r:
+            wire = json.loads(r.read())
+        for k in ("page_free_frac", "prefix_cache_size", "decode_ewma_ms",
+                  "drain_estimate_s", "queue_depth"):
+            assert k in wire
+    finally:
+        _stop_server(srv)
+
+
+def test_dense_engine_reports_slot_free_fraction(model):
+    eng = ContinuousBatchingEngine(
+        model, slots=2, max_len=64, prefill_buckets=[8], queue_depth=4,
+        seed=0, paged=False,
+    )
+    assert eng.healthz()["page_free_frac"] == 1.0
+    eng.submit(_prompt(4), max_new_tokens=4)
+    eng.step()  # admit into a slot
+    assert eng.healthz()["page_free_frac"] == 0.5
+    eng.run_until_idle()
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: uniformly typed error JSON (retriable + Retry-After driven)
+# ---------------------------------------------------------------------------
+
+
+def test_serve_errors_are_typed_json(model):
+    srv, eng, url = _replica_server(model)
+    try:
+        eng._step_ewma_s = 0.01  # evidence for a nonzero Retry-After
+        eng.submit(_prompt(4), max_new_tokens=8)
+        srv.drain(grace=0.5)
+        time.sleep(0.05)
+        status, body, headers = _post(url, {"input_ids": [1, 2, 3]})
+        assert status == 503
+        assert body["type"] == "Draining"
+        assert body["retriable"] is True
+        assert "error" in body
+    finally:
+        _stop_server(srv)
+
+
+def test_spent_deadline_header_is_non_retriable_504(model):
+    srv, eng, url = _replica_server(model)
+    try:
+        status, body, _ = _post(
+            url, {"input_ids": [1, 2, 3]}, headers={"X-Deadline-Ms": "0"}
+        )
+        assert status == 504
+        assert body["type"] == "DeadlineExceeded"
+        assert body["retriable"] is False
+    finally:
+        _stop_server(srv)
+
+
+def test_unattainable_deadline_is_retriable_504(model, monkeypatch):
+    srv, eng, url = _replica_server(model)
+    try:
+        # pin the backlog estimate (the live scheduler would relax it)
+        monkeypatch.setattr(eng, "estimate_drain_s", lambda: 10.0)
+        status, body, headers = _post(
+            url, {"input_ids": [1, 2, 3], "deadline_s": 0.05}
+        )
+        assert status == 504
+        assert body["type"] == "DeadlineUnattainable"
+        # retriable: a LESS LOADED replica may still meet the deadline —
+        # this is what lets the router fail over instead of giving up
+        assert body["retriable"] is True
+        assert int(headers.get("Retry-After", 0)) >= 1
+    finally:
+        _stop_server(srv)
+
+
+# ---------------------------------------------------------------------------
+# satellite 3: QueueFull Retry-After clamped by the request deadline
+# ---------------------------------------------------------------------------
+
+
+def test_queuefull_retry_after_clamped_by_deadline(model, monkeypatch):
+    eng = ContinuousBatchingEngine(
+        model, slots=2, max_len=64, prefill_buckets=[8], queue_depth=1, seed=0
+    )
+    eng.submit(_prompt(4), max_new_tokens=8)  # fill the queue (no scheduler)
+    # simulate the admission race the clamp exists for: the drain estimate
+    # is small at the deadline gate but has grown (concurrent admissions)
+    # by the time the queue insert fails
+    ests = iter([0.0, 50.0])
+    monkeypatch.setattr(eng, "estimate_drain_s", lambda: next(ests, 50.0))
+    with pytest.raises(QueueFull) as ei:
+        eng.submit(_prompt(4), max_new_tokens=8, deadline_s=2.0)
+    # never told to retry after its own deadline
+    assert ei.value.retry_after_s == 2.0
+    # without a deadline the raw estimate passes through
+    with pytest.raises(QueueFull) as ei:
+        eng.submit(_prompt(4), max_new_tokens=8)
+    assert ei.value.retry_after_s == 50.0
+
+
+# ---------------------------------------------------------------------------
+# deadline propagation: client -> router hop -> serve() hop -> engine
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_header_reaches_engine_submit(model, monkeypatch):
+    srv, eng, url = _replica_server(model)
+    seen = []
+    orig = eng.submit
+
+    def spy(*a, **kw):
+        seen.append(kw.get("deadline_s"))
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(eng, "submit", spy)
+    try:
+        status, body, _ = _post(
+            url, {"input_ids": _prompt(4).tolist(), "max_new_tokens": 2},
+            headers={"X-Deadline-Ms": "30000"},
+        )
+        assert status == 200
+        assert seen and seen[0] == pytest.approx(30.0, abs=0.5)
+    finally:
+        _stop_server(srv)
+
+
+def test_two_hop_deadline_propagation_shrinks_budget(model, monkeypatch):
+    """client --X-Deadline-Ms--> router --X-Deadline-Ms(remaining)-->
+    serve() --deadline_s--> engine.submit: each hop sees a strictly
+    bounded, shrinking budget."""
+    srv, eng, url = _replica_server(model)
+    seen = []
+    orig = eng.submit
+
+    def spy(*a, **kw):
+        seen.append(kw.get("deadline_s"))
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(eng, "submit", spy)
+    front = serve_router([url], port=0, block=False, probe=False)
+    front.router.probe_once()
+    fport = front.server_address[1]
+    try:
+        status, body, _ = _post(
+            f"http://127.0.0.1:{fport}",
+            {"input_ids": _prompt(4).tolist(), "max_new_tokens": 2},
+            headers={"X-Deadline-Ms": "30000"},
+        )
+        assert status == 200
+        # the engine saw the REMAINING budget: positive, below the
+        # client's 30s by the router+serve hop overhead
+        assert seen and 0 < seen[0] <= 30.0
+        # body deadline_s is equivalent client syntax at the router
+        seen.clear()
+        status, _, _ = _post(
+            f"http://127.0.0.1:{fport}",
+            {"input_ids": _prompt(4).tolist(), "max_new_tokens": 2,
+             "deadline_s": 25.0},
+        )
+        assert status == 200
+        assert seen and 0 < seen[0] <= 25.0
+    finally:
+        front.stop_router()
+        front.server_close()
+        _stop_server(srv)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker: closed -> open -> half-open trial -> closed
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_open_half_open_close_cycle():
+    rep = Replica("r0", "http://127.0.0.1:9", breaker_threshold=3,
+                  breaker_cooldown=0.05)
+    assert rep.breaker == "closed" and rep.allow()
+    rep.record_failure("x")
+    rep.record_failure("x")
+    assert rep.breaker == "closed"  # below threshold
+    rep.record_failure("x")
+    assert rep.breaker == "open"  # consecutive failures tripped it
+    assert not rep.allow()  # open: traffic blocked during cooldown
+    time.sleep(0.06)
+    assert rep.allow()  # cooldown elapsed -> half-open, ONE trial
+    assert rep.breaker == "half_open"
+    assert not rep.allow()  # second caller blocked while the trial flies
+    rep.record_failure("trial failed")
+    assert rep.breaker == "open"  # failed trial re-opens
+    time.sleep(0.06)
+    assert rep.allow()
+    rep.record_success(0.01)
+    assert rep.breaker == "closed"  # successful trial closes
+    assert rep.allow()
+    g = prof.router_summary()
+    # two trips: consecutive-failure open + the failed half-open trial
+    assert g["breaker_trips"] == 2
+    assert g["breaker_half_open"] == 2
+    assert g["breaker_closes"] == 1
+
+
+def test_probe_flap_opens_breaker_then_recovers(model):
+    srv, eng, url = _replica_server(model)
+    router = Router([url], probe_interval=3600, retry_backoff=0.01)
+    try:
+        router.probe_once()
+        assert router.replicas[0].state == "ready"
+        finj.arm("router.replica.flap:3")
+        for _ in range(3):
+            router.probe_once()
+        rep = router.replicas[0]
+        assert rep.state == "down"
+        assert rep.breaker == "open"
+        assert router.pick() is None  # a flapping replica takes no traffic
+        finj.disarm()
+        router.probe_once()  # healthy probe recovers state AND breaker
+        assert rep.state == "ready"
+        assert rep.breaker == "closed"
+        assert router.pick() is rep
+    finally:
+        router.stop()
+        _stop_server(srv)
+
+
+# ---------------------------------------------------------------------------
+# failover: retry on another replica, exactly-once, bit-identical
+# ---------------------------------------------------------------------------
+
+
+def test_failover_retries_on_survivor_bit_identical(model):
+    srv_a, eng_a, url_a = _replica_server(model)
+    srv_b, eng_b, url_b = _replica_server(model)
+    router = Router([url_a, url_b], probe_interval=3600, retry_backoff=0.01)
+    try:
+        router.probe_once()  # both ready; ties break toward index 0
+        _stop_server(srv_a)  # replica A dies AFTER the probe marked it ready
+        prompts = [_prompt(6, seed=i) for i in range(6)]
+        for i, p in enumerate(prompts):
+            status, body, _ = router.handle_generate(
+                {"input_ids": p.tolist(), "max_new_tokens": 6}
+            )
+            # every request resolves exactly once, on the survivor, with
+            # the exact tokens an undisturbed run produces
+            assert status == 200, body
+            assert np.array_equal(body["tokens"], _ref(model, p, 6))
+        g = prof.router_summary()
+        # the first breaker_threshold requests hit dead A then failed over;
+        # once the breaker opened, B was picked directly
+        assert g["retries"] >= 3
+        assert g["failovers"] >= 3
+        assert router.replicas[0].breaker == "open"
+        assert g["requests"] == len(prompts)
+    finally:
+        router.stop()
+        _stop_server(srv_b)
+
+
+def test_hedged_dispatch_wins_over_hung_replica(model):
+    srv_a, eng_a, url_a = _replica_server(model)
+    srv_b, eng_b, url_b = _replica_server(model)
+    router = Router([url_a, url_b], probe_interval=3600,
+                    retry_backoff=0.01, hedge_s=0.05)
+    try:
+        router.probe_once()
+        # warm both replicas (first request pays the compile) so the wall
+        # bound below measures routing, not tracing
+        for u in (url_a, url_b):
+            st, _, _ = _post(u, {"input_ids": [1, 2, 3], "max_new_tokens": 2})
+            assert st == 200
+        paddle.set_flags({"FLAGS_fault_hang_sec": 2.0})
+        finj.arm("router.replica.hang:1")  # wedge the primary dispatch
+        p = _prompt(6, seed=9)
+        t0 = time.monotonic()
+        status, body, _ = router.handle_generate(
+            {"input_ids": p.tolist(), "max_new_tokens": 4}
+        )
+        wall = time.monotonic() - t0
+        assert status == 200
+        assert np.array_equal(body["tokens"], _ref(model, p, 4))
+        assert wall < 2.0  # the hedge answered; the hang did not gate us
+        g = prof.router_summary()
+        assert g["hedges"] == 1
+        assert g["hedge_wins"] == 1
+    finally:
+        router.stop()
+        _stop_server(srv_a)
+        _stop_server(srv_b)
+
+
+# ---------------------------------------------------------------------------
+# brownout: bounded admission + shed over-deadline work first
+# ---------------------------------------------------------------------------
+
+
+def test_admission_gate_full_sheds_with_retry_after(model):
+    srv, eng, url = _replica_server(model)
+    router = Router([url], probe_interval=3600, max_inflight=0)
+    try:
+        router.probe_once()
+        status, body, headers = router.handle_generate(
+            {"input_ids": [1, 2, 3]}
+        )
+        assert status == 503
+        assert body["type"] == "RouterOverloaded"
+        assert body["retriable"] is True
+        assert prof.router_summary()["brownout_sheds"] == 1
+    finally:
+        router.stop()
+        _stop_server(srv)
+
+
+def test_brownout_sheds_over_deadline_work_first():
+    # a replica whose advertised backlog already exceeds the deadline:
+    # the router sheds without queueing (over-deadline work first), with
+    # Retry-After surfaced from the healthiest replica's drain estimate
+    rep = Replica("r0", "http://127.0.0.1:9")
+    rep._note_healthz({
+        "status": "ready", "queue_depth": 8, "active_slots": 2,
+        "drain_estimate_s": 50.0,
+    })
+    router = Router([rep], probe_interval=3600)
+    status, body, headers = router.handle_generate(
+        {"input_ids": [1, 2, 3]}, deadline_ms=1000
+    )
+    assert status == 504
+    assert body["type"] == "DeadlineUnattainable"
+    assert body["retriable"] is False
+    assert int(headers["Retry-After"]) == 50
+    assert prof.router_summary()["brownout_sheds"] == 1
+    # the same fleet still accepts work with no deadline (it would need a
+    # live endpoint to finish; shedding is deadline-driven, not global)
+    status, body, _ = router.handle_generate({"input_ids": [1, 2, 3]})
+    assert body["type"] != "DeadlineUnattainable"
+
+
+def test_no_ready_replica_is_typed_503():
+    rep = Replica("r0", "http://127.0.0.1:9")  # never probed ok: connecting
+    router = Router([rep], probe_interval=3600)
+    status, body, _ = router.handle_generate({"input_ids": [1]})
+    assert status == 503
+    assert body["type"] == "NoReadyReplica"
+    assert body["retriable"] is True
+    assert prof.router_summary()["no_replica"] == 1
+
+
+# ---------------------------------------------------------------------------
+# rolling drain/restart: zero dropped requests
+# ---------------------------------------------------------------------------
+
+
+def test_rolling_drain_zero_dropped_requests(model):
+    srv_a, eng_a, url_a = _replica_server(model)
+    srv_b, eng_b, url_b = _replica_server(model)
+    router = Router([url_a, url_b], probe_interval=0.05, retry_backoff=0.01)
+    restarted = []
+
+    def _warm_restart(rep, grace):
+        # in-process stand-in for the launch Container respawn: a warm
+        # engine restart behind the same HTTP front
+        eng = eng_a if rep.rid == "r0" else eng_b
+        eng.restart()
+        restarted.append(rep.rid)
+
+    results = []
+    results_mu = threading.Lock()
+    stop = threading.Event()
+
+    def _client(seed):
+        i = 0
+        while not stop.is_set():
+            p = _prompt(6, seed=seed * 100 + i)
+            status, body, _ = router.handle_generate(
+                {"input_ids": p.tolist(), "max_new_tokens": 4}
+            )
+            with results_mu:
+                results.append((p, status, body))
+            i += 1
+            time.sleep(0.02)  # bound the request count (each is verified)
+        return i
+
+    try:
+        router.start()
+        threads = [
+            threading.Thread(target=_client, args=(s,), daemon=True)
+            for s in range(3)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # steady load flowing before the upgrade starts
+        report = router.rolling_restart(grace=10.0, ready_timeout=10.0,
+                                        restart_fn=_warm_restart)
+        time.sleep(0.3)  # load continues after the fleet upgrade
+        stop.set()
+        for t in threads:
+            t.join(30)
+        assert restarted == ["r0", "r1"]
+        assert all(r["drained"] and r["ready"] for r in report)
+        # ZERO dropped requests: every routed request during the rolling
+        # upgrade resolved 200 with the exact undisturbed-run tokens
+        assert len(results) > 0
+        for p, status, body in results:
+            assert status == 200, body
+            assert np.array_equal(body["tokens"], _ref(model, p, 4))
+        # both replicas re-admitted and serving
+        assert {r.state for r in router.replicas} == {"ready"}
+    finally:
+        stop.set()
+        router.stop()
+        _stop_server(srv_a)
+        _stop_server(srv_b)
+
+
+# ---------------------------------------------------------------------------
+# router gauges surface in profiler.summary()
+# ---------------------------------------------------------------------------
+
+
+def test_router_gauges_in_profiler_summary(model, capsys):
+    srv, eng, url = _replica_server(model)
+    router = Router([url], probe_interval=3600)
+    try:
+        router.probe_once()
+        p = _prompt(4)
+        status, _, _ = router.handle_generate(
+            {"input_ids": p.tolist(), "max_new_tokens": 2}
+        )
+        assert status == 200
+        prof.Profiler().summary()
+        out = capsys.readouterr().out
+        assert "router:" in out
+        assert "breaker trips" in out
+        assert "r0=ready" in out
+        g = prof.router_summary()
+        assert g["requests"] == 1
+        assert g["replica_states"] == {"r0": "ready"}
+    finally:
+        router.stop()
+        _stop_server(srv)
+
+
+# ---------------------------------------------------------------------------
+# chaos drill (slow): kill -9 one subprocess replica under Poisson load
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_kill9_chaos_drill_exactly_once(model, tmp_path):
+    """Two router-managed subprocess replicas (launch Container topology).
+    Under Poisson load, the injected router.replica.kill SIGKILLs one
+    replica.  Every submitted request must resolve exactly once — retried
+    on the survivor or failed typed — and every 200 must be bit-identical
+    to an undisturbed run.  Afterwards a rolling restart revives the dead
+    replica through the Container respawn path and the fleet is whole."""
+    procs = [
+        ReplicaProcess(i, _free_port(), log_dir=str(tmp_path / "logs")).start()
+        for i in range(2)
+    ]
+    reps = [
+        Replica(f"r{i}", rp.url, process=rp) for i, rp in enumerate(procs)
+    ]
+    router = Router(reps, probe_interval=0.1, retry_backoff=0.02)
+    try:
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            router.probe_once()
+            if all(r.state == "ready" for r in reps):
+                break
+            time.sleep(0.5)
+        assert all(r.state == "ready" for r in reps), "replicas never booted"
+        router.start()
+
+        n_requests = 24
+        results = []
+        results_mu = threading.Lock()
+        rng = np.random.RandomState(7)
+
+        def _load():
+            for i in range(n_requests):
+                time.sleep(float(rng.exponential(0.05)))  # Poisson arrivals
+                p = _prompt(6, seed=1000 + i)
+                status, body, _ = router.handle_generate(
+                    {"input_ids": p.tolist(), "max_new_tokens": 4}
+                )
+                with results_mu:
+                    results.append((p, status, body))
+
+        threads = [threading.Thread(target=_load, daemon=True) for _ in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # load in flight...
+        finj.arm("router.replica.kill:1")  # ...then SIGKILL one replica
+        for t in threads:
+            t.join(300)
+        assert not any(t.is_alive() for t in threads)
+
+        # exactly once: one resolution per submitted request
+        assert len(results) == 2 * n_requests
+        ok = typed = 0
+        for p, status, body in results:
+            if status == 200:
+                ok += 1
+                # the survivor's greedy output is bit-identical to an
+                # undisturbed run (same seed -> same weights everywhere)
+                assert np.array_equal(body["tokens"], _ref(model, p, 4))
+            else:
+                typed += 1
+                assert body.get("type"), body  # failed TYPED, never silent
+        assert ok >= len(results) - 4  # zero-token retries recover the rest
+        killed = [rp for rp in procs if not rp.alive()]
+        assert len(killed) == 1  # the fault killed exactly one replica
+
+        # rolling restart revives the dead replica via Container respawn
+        # and re-admits it only after /healthz reports ready
+        report = router.rolling_restart(grace=10.0, ready_timeout=180.0)
+        assert all(r["ready"] for r in report), report
+        assert all(rp.alive() for rp in procs)
+        p = _prompt(6, seed=77)
+        status, body, _ = router.handle_generate(
+            {"input_ids": p.tolist(), "max_new_tokens": 4}
+        )
+        assert status == 200
+        assert np.array_equal(body["tokens"], _ref(model, p, 4))
+    finally:
+        router.stop()
+        for rp in procs:
+            rp.terminate()
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

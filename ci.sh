@@ -36,10 +36,16 @@ if [ "$MODE" = "chaos-serve" ]; then
   echo "== serving chaos suite (fault drills + slow HTTP drill, hard 15min cap) =="
   # the drills assert the engine-level watchdog/supervisor recovery; the
   # timeout(1) wrapper is the layer above it — a wedged restart path must
-  # fail CI, not hang it
+  # fail CI, not hang it.  PADDLE_OBS_DIR collects the flight-recorder
+  # dumps the watchdog trips / engine restarts write (asserted below)
+  OBS_DIR="$(mktemp -d)/flightrec"
   timeout -k 30 900 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      PADDLE_OBS_DIR="$OBS_DIR" \
       python -m pytest tests/test_serving_fault.py \
       -q -p no:cacheprovider
+  ls "$OBS_DIR"/flight-*.jsonl >/dev/null 2>&1 \
+      || { echo "FAIL: no flight-recorder dump after the watchdog drills" >&2; exit 1; }
+  echo "flight-recorder dumps: $(ls "$OBS_DIR" | wc -l) in $OBS_DIR"
   echo "== paged-KV warm-restart drill (ISSUE 7) =="
   # warm restart must preserve the prefix cache AND the compiled set: the
   # first shared-prefix request after restart() is a cache hit served with
@@ -74,9 +80,16 @@ if [ "$MODE" = "chaos" ]; then
   # test_compile_cache.py's slow tests cover the cold-start acceptance:
   # warm gang restart resumes inside the tightened first-step deadline,
   # and a fresh process pays 0 fresh XLA compiles from the warm cache.
+  # PADDLE_OBS_DIR collects the flight-recorder dumps the collective
+  # watchdog and the gang-restart controller write (asserted below)
+  OBS_DIR="$(mktemp -d)/flightrec"
   timeout -k 30 1200 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      PADDLE_OBS_DIR="$OBS_DIR" \
       python -m pytest tests/test_fault_tolerance.py tests/test_compile_cache.py \
       -q -m slow -p no:cacheprovider
+  ls "$OBS_DIR"/flight-*.jsonl >/dev/null 2>&1 \
+      || { echo "FAIL: no flight-recorder dump after the gang-restart drills" >&2; exit 1; }
+  echo "flight-recorder dumps: $(ls "$OBS_DIR" | wc -l) in $OBS_DIR"
   echo "CHAOS OK"
   exit 0
 fi
@@ -158,6 +171,17 @@ ROUTER_TESTS=(tests/test_serving_router.py::test_failover_retries_on_survivor_bi
 [ "$MODE" != "fast" ] && ROUTER_TESTS=(tests/test_serving_router.py)
 timeout -k 30 600 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python -m pytest "${ROUTER_TESTS[@]}" -q -m "not slow" -p no:cacheprovider
+
+echo "== observability smoke (ISSUE 10 acceptance subset) =="
+# both tiers scrape a live replica's /metrics (stable name set, replica
+# label) and round-trip GET /trace/<id> over a traced request; fast mode
+# runs that pair, full mode the whole file (span buffer bounds, flight
+# ring/dumps, fit spans, router /metrics role label)
+OBS_TESTS=(tests/test_observability.py::test_metrics_scrape_stable_names_and_format
+           tests/test_observability.py::test_serve_trace_http_round_trip)
+[ "$MODE" != "fast" ] && OBS_TESTS=(tests/test_observability.py)
+timeout -k 30 600 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python -m pytest "${OBS_TESTS[@]}" -q -p no:cacheprovider
 
 if [ "$MODE" != "fast" ]; then
   echo "== bench smoke (CPU) =="

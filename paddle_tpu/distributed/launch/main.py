@@ -440,6 +440,20 @@ class CollectiveController:
                 f"{args.max_restart} in {delay:.1f}s",
                 file=sys.stderr,
             )
+            try:
+                # controller-side flight-recorder dump: the gang is about to
+                # be torn down and respawned, so write the event timeline
+                # next to the checkpoints the restart will resume from
+                from ...obs import flight as _flight
+
+                _flight.record(
+                    "launch",
+                    f"gang restart {restarts}/{args.max_restart}: {why}",
+                    exit_code=code, delay_s=round(delay, 2),
+                )
+                _flight.dump(f"gang-restart-{restarts}")
+            except ImportError:
+                pass
             time.sleep(delay)
             if multi:
                 # a restarted trainer cannot rejoin a live jax.distributed

@@ -59,8 +59,15 @@ _events = collections.deque(maxlen=64)
 
 
 def record_event(kind, detail=""):
-    """Append to the fault-event ring buffer (thread-safe: deque append)."""
+    """Append to the fault-event ring buffer (thread-safe: deque append).
+    Every event is mirrored into the obs flight recorder so post-mortem
+    dumps carry the fault timeline without double bookkeeping at sites."""
     _events.append({"t": time.monotonic(), "kind": kind, "detail": str(detail)})
+    try:
+        from ..obs import flight as _flight
+        _flight.record(kind, detail)
+    except Exception:
+        pass
 
 
 def recent_events(n=None):

@@ -433,6 +433,12 @@ class EngineSupervisor:
             _inj.record_event(
                 "engine", f"restart budget exhausted after {spent} ({reason})"
             )
+            try:
+                from ..obs import flight as _flight
+
+                _flight.dump("engine-restart-budget-exhausted")
+            except Exception:
+                pass
             with self._state_mu:
                 self.dead = True
             self.engine.fail_all(f"restart budget exhausted ({reason})")
@@ -441,6 +447,14 @@ class EngineSupervisor:
             "engine supervisor: %s; engine restart %d/%d in %.2fs",
             reason, spent, self.max_restarts, delay,
         )
+        try:
+            # dump BEFORE the restart clears engine state: the timeline up
+            # to the trip is what the post-mortem needs
+            from ..obs import flight as _flight
+
+            _flight.dump(f"engine-restart-{spent}")
+        except Exception:
+            pass
         if delay > 0:
             time.sleep(delay)
         self.engine.restart(reason)

@@ -35,6 +35,11 @@ import traceback
 
 from ..framework import core as _core
 
+try:
+    from ..obs import flight as _flight
+except ImportError:  # fault layer stays importable standalone
+    _flight = None
+
 logger = logging.getLogger("paddle_tpu")
 
 _core.define_flag(
@@ -138,6 +143,15 @@ def _fire(r):
     from . import injection as _inj
 
     _inj.record_event("watchdog", f"fired: {r.region} after {r.timeout:.1f}s")
+    try:
+        # the trip is the canonical "state is about to be lost" moment —
+        # ship the flight-recorder timeline before any action runs (the
+        # "exit" action never returns)
+        from ..obs import flight as _flight
+
+        _flight.dump(f"watchdog-{r.region}")
+    except Exception:
+        pass
     action = r.watchdog.action
     if callable(action):
         action(r.region, r.timeout)
@@ -176,6 +190,11 @@ class Watchdog:
         if t <= 0:
             yield
             return
+        if _flight is not None:
+            # last-arm-per-region gauge, not a ring event: decode/fetch arm
+            # per scheduler tick and would evict everything else from the
+            # flight ring; the dump header still shows what was armed when
+            _flight.note_arm(region, context)
         _ensure_monitor()
         r = _Region(next(_ids), region, time.monotonic() + t, t, context, self)
         with _cv:

@@ -546,6 +546,20 @@ define_flag(
     "source line, surfaced in profiler.summary(), and raised as a hard "
     "error by the test suite's sanitize fixture",
 )
+define_flag(
+    "FLAGS_trace",
+    os.environ.get("PADDLE_TRACE", "") not in ("", "0", "false"),
+    "host-side request tracing (paddle_tpu.obs): record per-stage spans "
+    "(router.admit, replica.forward, serve.handle, engine.queue/prefill/"
+    "decode/fetch, fit.step/window) into a bounded in-memory buffer, "
+    "exported on GET /trace/<id> and as Chrome-trace JSON.  Pure host-side "
+    "bookkeeping — no recompiles, no device syncs; off by default",
+)
+define_flag(
+    "FLAGS_obs_buffer_events", 4096,
+    "capacity of the obs span buffer and the flight-recorder event ring "
+    "(paddle_tpu.obs); oldest entries are evicted first",
+)
 
 
 # ---------------------------------------------------------------------------

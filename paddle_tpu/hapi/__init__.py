@@ -252,6 +252,7 @@ class Model:
         from ..analysis import sanitizer as _san
         from ..fault import watchdog as _wd
         from ..framework import core as _core
+        from ..obs import trace as _obs
         from .. import profiler as _prof
 
         loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
@@ -275,6 +276,10 @@ class Model:
         inflight = max(1, int(_core.flag("FLAGS_max_inflight_steps")))
         sync_mode = inflight <= 1
         cblist.call("on_train_begin")
+        # one trace per fit() run: per-step dispatch spans parent on the
+        # enclosing materialize window, so the async pipeline's shape
+        # (many dispatches, one sync) is visible in the trace viewer
+        fit_tid = _obs.new_trace_id()
         history = []
         with Supervisor(save_fn=save_fn, max_bad_steps=max_bad_steps) as sup:
             for epoch in range(epochs):
@@ -284,12 +289,16 @@ class Model:
                 epoch_sum, epoch_n = 0.0, 0
                 window = []  # device losses since the last sync point
                 ring = collections.deque()  # bounded in-flight steps
+                # pre-minted window span id: fit.step spans parent on it
+                win = {"sid": _obs.new_span_id(), "t0": time.perf_counter(),
+                       "steps": 0}
 
                 def _materialize():
                     """One host sync for the whole window: the stacked
                     losses come back together, and the supervisor ring
                     drains with the SAME values (no second round-trip)."""
-                    nonlocal epoch_sum, epoch_n, window
+                    nonlocal epoch_sum, epoch_n, window, win
+                    n_win = len(window)
                     vals = _materialize_losses(window)
                     window = []
                     ring.clear()  # everything up to here has retired
@@ -297,6 +306,11 @@ class Model:
                     for v in vals:  # per-value float64 adds: the epoch mean
                         epoch_sum += float(v)  # is window-size invariant
                     epoch_n += len(vals)
+                    t_now = time.perf_counter()
+                    _obs.record("fit.window", fit_tid, t0=win["t0"], t1=t_now,
+                                span_id=win["sid"], epoch=epoch,
+                                steps=win["steps"], losses=n_win)
+                    win = {"sid": _obs.new_span_id(), "t0": t_now, "steps": 0}
                     return vals
 
                 last_end = time.perf_counter()
@@ -321,6 +335,9 @@ class Model:
                         with ss:
                             loss_t = self.train_batch(x, y)[0]
                     t1 = time.perf_counter()
+                    _obs.record("fit.step", fit_tid, t0=t0, t1=t1,
+                                parent_id=win["sid"], step=step, epoch=epoch)
+                    win["steps"] += 1
                     window.append(getattr(loss_t, "_raw", loss_t))
                     sup.after_step(loss_t)  # deferred: heartbeat + preemption
                     # poll now, finiteness at the next drain

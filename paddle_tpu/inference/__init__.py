@@ -169,6 +169,10 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
     - GET  /health            -> 200
     - GET  /healthz           -> lifecycle snapshot: status live/ready/
       draining/dead + occupancy, queue depth, restart count, drain estimate
+    - GET  /metrics           -> Prometheus text exposition (profiler,
+      sanitizer, trace and flight-recorder counters; replica label)
+    - GET  /trace/<id>        -> per-request span tree (populated when
+      FLAGS_trace is on; POST responses carry X-Trace-Id)
     - POST /predict           -> {"outputs": [...]}   (Predictor)
     - POST /generate          -> {"tokens": [...]}    (GenerationPredictor or
       ContinuousBatchingEngine; body: {"input_ids": [...] or [[...], ...],
@@ -200,6 +204,9 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
     from .engine import ContinuousBatchingEngine, EngineUnavailable
     from ..fault import EngineSupervisor
     from ..framework import core as _fcore
+    from ..obs import flight as _flight
+    from ..obs import metrics as _obs_metrics
+    from ..obs import trace as _obs
 
     predictor = (
         path_or_predictor
@@ -231,6 +238,9 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            tid = getattr(self, "_trace_id", None)
+            if tid:
+                self.send_header(_obs.HDR_TRACE, tid)
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
@@ -238,7 +248,9 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
 
         def _reply_error(self, code, err_type, msg, retriable, retry_after=None):
             # uniformly typed error JSON: the router's retry decision is
-            # driven by `retriable` + Retry-After, never by string matching
+            # driven by `retriable` + Retry-After, never by string matching;
+            # trace_id joins the failure to its span tree across hops
+            self._err = err_type
             headers = {}
             if retry_after:
                 headers["Retry-After"] = str(max(1, int(retry_after + 0.5)))
@@ -249,6 +261,7 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
                     "type": err_type,
                     "retriable": bool(retriable),
                     "retry_after_s": retry_after or 0,
+                    "trace_id": getattr(self, "_trace_id", None),
                 },
                 headers,
             )
@@ -276,6 +289,24 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
                 self._reply(200, {"status": "ok"})
             elif self.path == "/healthz":
                 self._healthz()
+            elif self.path == "/metrics":
+                # bound address, not the port argument (0 = ephemeral)
+                bh, bp = self.server.server_address[:2]
+                body = _obs_metrics.render(
+                    labels={"replica": f"{bh}:{bp}"}
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", _obs_metrics.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path.startswith("/trace/"):
+                tid = self.path[len("/trace/"):]
+                roots = _obs.tree(tid)
+                if roots:
+                    self._reply(200, {"trace_id": tid, "spans": roots})
+                else:
+                    self._reply(404, {"error": f"no spans buffered for trace {tid!r}"})
             else:
                 self._reply(404, {"error": "use POST /predict"})
 
@@ -314,6 +345,7 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
                                 temperature=float(req.get("temperature", 0.0)),
                                 eos_token_id=req.get("eos_token_id"),
                                 deadline_s=deadline_s,
+                                trace=(self._trace_id, self._handle_sid),
                             )
                         )
                 except engine_mod.DeadlineUnattainable as e:
@@ -351,6 +383,28 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
                 )
 
         def do_POST(self):
+            # trace context: join the caller's (router hop headers) or mint
+            # a root — minting is always on so error bodies carry trace_id;
+            # the serve.handle span id is pre-minted so engine stage spans
+            # can parent on it before the handle span itself completes
+            ctx = _obs.ctx_from_headers(self.headers)
+            self._trace_id = ctx[0] if ctx else _obs.new_trace_id()
+            self._handle_sid = _obs.new_span_id()
+            self._err = None
+            t0 = _time.perf_counter()
+            try:
+                self._do_post()
+            finally:
+                _obs.record(
+                    "serve.handle", self._trace_id,
+                    t0=t0, t1=_time.perf_counter(),
+                    span_id=self._handle_sid,
+                    parent_id=(ctx[1] if ctx else None),
+                    status="error" if self._err else "ok",
+                    path=self.path, error=self._err,
+                )
+
+        def _do_post(self):
             if state["draining"]:
                 self._busy("server draining, retry elsewhere",
                            err_type="Draining")
@@ -430,6 +484,12 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
             )
 
         def _worker():
+            # a drain is the process's last orderly moment — persist the
+            # flight ring before in-flight work winds down and we exit
+            try:
+                _flight.dump("serve-drain")
+            except Exception:
+                pass
             if engine is not None:
                 engine.drain()
                 deadline = _time.monotonic() + float(grace)

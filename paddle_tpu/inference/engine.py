@@ -91,6 +91,8 @@ from ..analysis import sanitizer as _san
 from ..fault import injection as _inj
 from ..fault import watchdog as _wd
 from ..framework import core as _fcore
+from ..obs import flight as _flight
+from ..obs import trace as _obs
 from ..models.llama import (
     PagedDecodeView,
     PagedKVCache,
@@ -180,8 +182,11 @@ class EngineRequest:
     {eos, length, timeout, cancelled, restarted, error} — exactly once."""
 
     def __init__(self, rid, prompt, max_new_tokens, temperature, eos_token_id,
-                 on_token, deadline_s=None):
+                 on_token, deadline_s=None, trace=None):
         self.id = int(rid)
+        # (trace_id, parent_span_id) from the submitting hop, or None;
+        # every engine-stage span for this request parents under it
+        self.trace = trace
         self.prompt = prompt  # np.int32 [L]
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -350,6 +355,9 @@ class ContinuousBatchingEngine:
         # device-resident decode loop state (toks, pos, active, temps),
         # rebuilt from the host mirrors only when slot membership changes
         self._dev = None
+        # open decode-epoch summary for tracing: {"t0", "ticks", "members"},
+        # one engine.decode span per traced member when membership changes
+        self._ep = None
         # decode steps dispatched but not yet fetched to host:
         # [(nxt, finite, active_idx, dispatch_t)]
         self._pending_fetch = []
@@ -575,7 +583,8 @@ class ContinuousBatchingEngine:
     # -- public API ---------------------------------------------------------
 
     def submit(self, input_ids, max_new_tokens=32, temperature=0.0,
-               eos_token_id=None, on_token=None, deadline_s=None):
+               eos_token_id=None, on_token=None, deadline_s=None,
+               trace=None):
         """Enqueue one request (1-D token ids).  Returns an EngineRequest
         handle immediately; raises QueueFull when the admission queue is at
         capacity, DeadlineUnattainable when `deadline_s` cannot beat the
@@ -608,6 +617,10 @@ class ContinuousBatchingEngine:
             est = self.estimate_drain_s()
             if est > float(deadline_s):
                 _prof.record_serving_fault("rejected_deadline")
+                _flight.record(
+                    "admission", "rejected_deadline",
+                    deadline_s=float(deadline_s), drain_est_s=round(est, 3),
+                )
                 raise DeadlineUnattainable(
                     f"deadline {deadline_s}s cannot beat the current "
                     f"queue-drain estimate {est:.2f}s",
@@ -628,7 +641,7 @@ class ContinuousBatchingEngine:
                 )
         req = EngineRequest(
             next(self._req_ids), ids, max_new_tokens, temperature,
-            eos_token_id, on_token, deadline_s=deadline_s,
+            eos_token_id, on_token, deadline_s=deadline_s, trace=trace,
         )
         req._submit_t = time.perf_counter()
         if deadline_s is not None:
@@ -636,6 +649,8 @@ class ContinuousBatchingEngine:
         try:
             self._queue.put_nowait(req)
         except queue.Full:
+            _flight.record("admission", "queue_full",
+                           queue_depth=self.queue_depth)
             raise QueueFull(
                 f"admission queue full ({self.queue_depth} pending)",
                 retry_after_s=self._shed_retry_after(deadline_s),
@@ -970,6 +985,7 @@ class ContinuousBatchingEngine:
             self._pos[:] = 0
             self._last_tok[:] = 0
             self._temps[:] = 0.0
+            self._ep = None  # epoch members were restarted; drop, don't record
             self._dev = None
             self._pending_fetch = []
             self._watchdog_trip = None
@@ -1031,6 +1047,7 @@ class ContinuousBatchingEngine:
             self._pos[:] = 0
             self._last_tok[:] = 0
             self._temps[:] = 0.0
+            self._ep = None
             self._dev = None
             self._pending_fetch = []
         finally:
@@ -1293,6 +1310,10 @@ class ContinuousBatchingEngine:
             key = self._key
         L = int(req.prompt.size)
         bucket = self._bucket_for(L)
+        t_pf = time.perf_counter()
+        if req.trace:
+            _obs.record("engine.queue", req.trace[0], t0=req._submit_t,
+                        t1=t_pf, parent_id=req.trace[1], req=req.id)
         # cache rows run out at max_len: the last writable decode row is
         # max_len - 1, giving max_len - L generatable tokens
         req.max_new_tokens = min(req.max_new_tokens, self.max_len - L)
@@ -1324,8 +1345,13 @@ class ContinuousBatchingEngine:
             self._last_tok[s] = tok
             self._temps[s] = req.temperature
             req.state = "decoding"
+            self._obs_epoch_close()
             self._dev = None  # membership changed: rebuild device loop state
             self._emit(s, req, tok)
+        if req.trace:
+            _obs.record("engine.prefill", req.trace[0], t0=t_pf,
+                        t1=time.perf_counter(), parent_id=req.trace[1],
+                        req=req.id, bucket=bucket, slot=s)
 
     def _prefill_into_paged(self, s, req, gen):
         """Paged admission: prefix-cache lookup, page mapping (shared fulls
@@ -1393,6 +1419,10 @@ class ContinuousBatchingEngine:
             row_table = self._page_table[s].copy()
         suffix = L - match_len
         bucket = self._bucket_for(suffix)
+        t_pf = time.perf_counter()
+        if req.trace:
+            _obs.record("engine.queue", req.trace[0], t0=req._submit_t,
+                        t1=t_pf, parent_id=req.trace[1], req=req.id)
         toks = np.zeros((1, bucket), np.int32)
         toks[0, :suffix] = req.prompt[match_len:]
         try:
@@ -1444,8 +1474,16 @@ class ContinuousBatchingEngine:
             self._last_tok[s] = tok
             self._temps[s] = req.temperature
             req.state = "decoding"
+            self._obs_epoch_close()
             self._dev = None  # membership changed: rebuild device loop state
             self._emit(s, req, tok)
+        if req.trace:
+            _obs.record(
+                "engine.chunk_prefill" if match_len else "engine.prefill",
+                req.trace[0], t0=t_pf, t1=time.perf_counter(),
+                parent_id=req.trace[1], req=req.id, bucket=bucket, slot=s,
+                prefix_match=match_len or None,
+            )
 
     def _decode_once(self, gen):
         from .. import profiler as _prof
@@ -1458,6 +1496,7 @@ class ContinuousBatchingEngine:
                 return 0
             t0 = time.perf_counter()
             if self._dev is None:
+                self._obs_epoch_close()
                 active = np.zeros(self.slots, bool)
                 active[active_idx] = True
                 self._dev = (
@@ -1470,6 +1509,7 @@ class ContinuousBatchingEngine:
                     # same events that invalidate _dev — so one H2D mirror
                     # per membership change covers every following step
                     self._tables_t = to_tensor(self._page_table.copy())
+                self._obs_epoch_open(active_idx)
             toks_t, pos_t, active_t, temps_t = self._dev
             key = self._key
             poison_t, poisoned = self._poison_zero, None
@@ -1513,6 +1553,8 @@ class ContinuousBatchingEngine:
                 for s in active_idx
             ):
                 self._flush_pending_locked()
+            if self._ep is not None:
+                self._ep["ticks"] += 1
             _prof.record_serving_tick(
                 len(active_idx) / self.slots, self._queue.qsize(),
                 time.perf_counter() - t0,
@@ -1522,6 +1564,35 @@ class ContinuousBatchingEngine:
                     self._pool.used_count(), self._pool.usable_pages
                 )
         return len(active_idx)
+
+    def _obs_epoch_open(self, active_idx):
+        """Start a decode-epoch summary (caller holds _mu): the stretch of
+        constant slot membership that begins at this device-state rebuild.
+        Host-side bookkeeping only — a dict, no tensor touches — so it is
+        legal inside the sanitizer's steady-state zone."""
+        if not _obs.enabled():
+            self._ep = None
+            return
+        members = [(s, self._slot_req[s]) for s in active_idx]
+        if not any(r.trace for _, r in members):
+            self._ep = None
+            return
+        self._ep = {"t0": time.perf_counter(), "ticks": 0, "members": members}
+
+    def _obs_epoch_close(self):
+        """Close the open decode epoch (caller holds _mu): one summarizing
+        engine.decode span per traced member request."""
+        ep, self._ep = self._ep, None
+        if not ep or not ep["ticks"]:
+            return
+        t1 = time.perf_counter()
+        for s, req in ep["members"]:
+            if req.trace:
+                _obs.record(
+                    "engine.decode", req.trace[0], t0=ep["t0"], t1=t1,
+                    parent_id=req.trace[1], req=req.id, slot=s,
+                    ticks=ep["ticks"],
+                )
 
     def _flush_pending_locked(self):
         """Fetch every dispatched-but-unfetched decode step and emit its
@@ -1537,6 +1608,7 @@ class ContinuousBatchingEngine:
             return
         gen0 = self._gen
         batches, self._pending_fetch = self._pending_fetch, []
+        t_f0 = time.perf_counter()
         with self._watchdog.arm(
             "serve.fetch", timeout=self._wd_timeout(),
             context=f"{len(batches)} buffered steps",
@@ -1552,6 +1624,17 @@ class ContinuousBatchingEngine:
             ]
         self._check_gen(gen0)
         now = time.perf_counter()
+        if _obs.enabled():
+            flushed = {}
+            for _nxt, _fin, idx, _t0 in fetched:
+                for s in idx:
+                    r = self._slot_req[s]
+                    if r is not None and r.trace:
+                        flushed[r.id] = r
+            for r in flushed.values():
+                _obs.record("engine.fetch", r.trace[0], t0=t_f0, t1=now,
+                            parent_id=r.trace[1], req=r.id,
+                            steps=len(fetched))
         # EWMA decode-round wall time: dispatch-to-fetch of this burst over
         # its step count — feeds estimate_drain_s / Retry-After
         per = (now - fetched[0][3]) / len(fetched)
@@ -1600,6 +1683,7 @@ class ContinuousBatchingEngine:
             # mappings drop; committed prefix pages live on through the
             # cache's own hold, everything else returns to the free list
             self._release_slot_pages_locked(s)
+        self._obs_epoch_close()
         self._dev = None  # membership changed: rebuild device loop state
         self._resolve(req, reason)
 

@@ -1,0 +1,40 @@
+"""Observability spine (ISSUE 10): request tracing, /metrics, flight recorder.
+
+Three host-side-only pieces that share one design rule — nothing in here may
+touch a tensor, enter a compiled region, or force a device sync, so every
+hook is safe inside the sanitizer's steady-state zones and adds no recompile
+hazard:
+
+- ``obs.trace``   — trace contexts minted at the router (or first hop),
+  propagated as ``X-Trace-Id``/``X-Parent-Span`` next to the existing
+  ``X-Deadline-Ms`` header, with per-stage spans recorded into a bounded
+  lock-safe buffer; exportable as a span tree (``GET /trace/<id>``) or
+  Chrome-trace/Perfetto JSON.
+- ``obs.metrics`` — a Prometheus text renderer over every profiler counter
+  family (training, serving, paging, router, flash fallbacks), the runtime
+  sanitizer, and the obs buffers themselves, served from ``GET /metrics``
+  on both ``serve()`` and the router.
+- ``obs.flight``  — a fixed-size ring of recent structured events (fault
+  firings, watchdog arms/trips, breaker transitions, restarts, admission
+  rejections, terminal span completions) dumped to ``$PADDLE_CKPT_DIR``-
+  adjacent JSONL by watchdog trips, supervisor restarts, SIGTERM drains,
+  and the launch controller's gang-restart path.
+
+Gated by ``FLAGS_trace`` (span recording on/off; metrics and the flight
+ring are always live) and sized by ``FLAGS_obs_buffer_events``.
+"""
+
+from . import flight, metrics, trace  # noqa: F401
+from .trace import (  # noqa: F401
+    HDR_PARENT,
+    HDR_TRACE,
+    chrome_trace,
+    ctx_from_headers,
+    enabled,
+    new_span_id,
+    new_trace_id,
+    record,
+    span,
+    spans,
+    tree,
+)

@@ -1,0 +1,184 @@
+"""Crash flight recorder: a bounded ring of recent structured events.
+
+Every interesting runtime event — fault-injection firings, watchdog
+arms/trips, circuit-breaker transitions, engine/supervisor restarts,
+admission rejections, terminal span completions — lands in one fixed-size
+in-memory ring (``FLAGS_obs_buffer_events`` entries).  The ring costs a
+lock + dict append per event and is always on; it only touches disk when a
+fault path asks for a post-mortem via ``dump(reason)``, which writes the
+whole ring as JSONL next to the checkpoint directory:
+
+    $PADDLE_OBS_DIR                    when set (tests, operators), else
+    $PADDLE_CKPT_DIR + "_flightrec"    (adjacent to the checkpoints the
+                                        restart will resume from), else
+    <tmpdir>/paddle_flightrec
+
+Dump triggers are the paths where state is about to be lost: watchdog trips
+(``fault/watchdog.py``), ``EngineSupervisor`` restarts and budget
+exhaustion (``fault/supervisor.py``), SIGTERM drains (``inference.serve``),
+and the launch controller's gang-restart (``distributed/launch``).  The
+dump format is one JSON object per line: a header record (reason, pid,
+per-region "last watchdog arm" snapshot) followed by the ring, oldest
+first.  ``dump`` never raises — it runs on fault paths that must proceed.
+"""
+
+import collections
+import json
+import os
+import re
+import tempfile
+import threading
+import time
+
+from ..framework import core as _core
+
+_DEFAULT_CAPACITY = 4096
+
+_mu = threading.Lock()
+_events = collections.deque(maxlen=_DEFAULT_CAPACITY)
+_capacity = _DEFAULT_CAPACITY
+_total = 0
+_dumps = 0
+_last_dump = None
+# region -> {"t", "context"}: the LAST watchdog arm per region.  Arms fire
+# per scheduler tick in the decode hot loop, so they would instantly evict
+# everything else from the ring as events; a per-region last-write gauge
+# keeps "what was armed when it died" in every dump at O(regions) cost.
+_armed = {}
+
+# span names mirrored into the ring on completion (trace.record calls
+# note_span for every span; only request-terminal ones ride the ring)
+_SPAN_KINDS = ("router.admit", "serve.handle", "replica.forward",
+               "fit.window")
+
+
+def _ensure_capacity_locked():
+    global _events, _capacity
+    try:
+        cap = int(_core.flag("FLAGS_obs_buffer_events"))
+    except Exception:
+        cap = _DEFAULT_CAPACITY
+    cap = max(16, cap)
+    if cap != _capacity:
+        _events = collections.deque(_events, maxlen=cap)
+        _capacity = cap
+
+
+def record(kind, detail="", **fields):
+    """Append one structured event to the ring (always on, never raises)."""
+    global _total
+    try:
+        ev = {"t": time.time(), "kind": str(kind), "detail": str(detail)}
+        for k, v in fields.items():
+            if v is not None:
+                ev[str(k)] = v
+        with _mu:
+            _ensure_capacity_locked()
+            _events.append(ev)
+            _total += 1
+    except Exception:
+        pass
+
+
+def note_span(span_rec):
+    """Mirror a terminal span completion into the ring (called by trace)."""
+    if span_rec.get("name") not in _SPAN_KINDS:
+        return
+    record(
+        "span", span_rec["name"],
+        trace_id=span_rec.get("trace_id"),
+        span_id=span_rec.get("span_id"),
+        status=span_rec.get("status"),
+        dur_ms=round(span_rec.get("dur_s", 0.0) * 1e3, 3),
+    )
+
+
+def note_arm(region, context=None):
+    """Remember the latest watchdog arm per region (dumped in the header)."""
+    try:
+        with _mu:
+            _armed[str(region)] = {
+                "t": time.time(), "context": str(context or ""),
+            }
+    except Exception:
+        pass
+
+
+def events(n=None):
+    """Snapshot of the ring, oldest first (last ``n`` when given)."""
+    with _mu:
+        out = list(_events)
+    return out[-n:] if n else out
+
+
+def stats():
+    with _mu:
+        return {
+            "events_total": _total,
+            "dumps_total": _dumps,
+            "events_buffered": len(_events),
+        }
+
+
+def last_dump_path():
+    with _mu:
+        return _last_dump
+
+
+def dump_dir():
+    d = os.environ.get("PADDLE_OBS_DIR")
+    if d:
+        return d
+    ckpt = os.environ.get("PADDLE_CKPT_DIR")
+    if ckpt:
+        return ckpt.rstrip("/\\") + "_flightrec"
+    return os.path.join(tempfile.gettempdir(), "paddle_flightrec")
+
+
+def dump(reason, path=None):
+    """Write the ring as JSONL; returns the path, or None on any failure.
+
+    Runs on fault paths (watchdog trip, supervisor restart, SIGTERM drain,
+    gang restart) — it must never raise and never block on anything but
+    local disk.
+    """
+    global _dumps, _last_dump
+    try:
+        with _mu:
+            ring = list(_events)
+            armed = {k: dict(v) for k, v in _armed.items()}
+            _dumps += 1
+            seq = _dumps
+        if path is None:
+            d = dump_dir()
+            os.makedirs(d, exist_ok=True)
+            safe = re.sub(r"[^A-Za-z0-9._-]+", "-", str(reason))[:64] or "dump"
+            path = os.path.join(
+                d, f"flight-{os.getpid()}-{seq:03d}-{safe}.jsonl"
+            )
+        header = {
+            "kind": "header",
+            "reason": str(reason),
+            "t": time.time(),
+            "pid": os.getpid(),
+            "events": len(ring),
+            "armed": armed,
+        }
+        with open(path, "w") as f:
+            f.write(json.dumps(header) + "\n")
+            for ev in ring:
+                f.write(json.dumps(ev, default=str) + "\n")
+        with _mu:
+            _last_dump = path
+        return path
+    except Exception:
+        return None
+
+
+def reset():
+    """Clear ring + gauges (tests); dump counters are kept monotonic."""
+    global _last_dump
+    with _mu:
+        _events.clear()
+        _armed.clear()
+        _last_dump = None

@@ -1,0 +1,219 @@
+"""Prometheus text exposition over every counter family in the repo.
+
+``render(labels={...})`` returns the classic ``text/plain; version=0.0.4``
+format: ``# HELP``/``# TYPE`` per metric name, then one sample per label
+set.  It reads ``profiler.metrics_snapshot()`` (one raw one-lock snapshot
+that, unlike the ``*_summary()`` helpers, never omits zero-valued counters
+— exported metric NAMES are stable whether or not traffic has flowed),
+plus the runtime sanitizer's counters and the obs buffers' own gauges.
+
+Both HTTP front doors mount this on ``GET /metrics``: ``serve()`` labels
+every sample ``{replica="host:port"}``, the router ``{role="router"}``, so
+a fleet scrape distinguishes replicas without per-process config.
+
+Metric-name reference (the stable surface the scrape test pins):
+
+    paddle_train_steps_total            paddle_serving_requests_total
+    paddle_train_dispatch_seconds_total paddle_serving_tokens_total
+    paddle_train_host_blocked_seconds_total
+    paddle_train_wall_seconds_total     paddle_serving_ticks_total
+    paddle_train_inflight_max           paddle_serving_busy_seconds_total
+    paddle_serving_ttft_seconds{quantile="0.5"|"0.95"}
+    paddle_serving_occupancy_mean / _peak
+    paddle_serving_queue_depth_max
+    paddle_serving_faults_total{kind=...}
+    paddle_paging_prefix_hits_total / _misses_total
+    paddle_paging_prefill_tokens_saved_total
+    paddle_paging_cow_copies_total
+    paddle_paging_cache_evictions_total / _commits_total
+    paddle_paging_pages_used_peak / paddle_paging_pages_total
+    paddle_router_requests_total, _retries_total, _failovers_total,
+    paddle_router_breaker_trips_total / _half_open_total / _closes_total
+    paddle_router_hedges_total / _hedge_wins_total
+    paddle_router_brownout_sheds_total / _deadline_sheds_total
+    paddle_router_no_replica_total
+    paddle_router_replica_state{replica=...,state=...} 1
+    paddle_flash_fallbacks_total{reason=...}
+    paddle_sanitizer_<counter>_total  (traces, eager_misses, host_syncs,
+        unexpected_traces, unexpected_eager, unexpected_syncs,
+        allowed_events)
+    paddle_obs_spans_recorded_total / _dropped_total / _buffered
+    paddle_flight_events_total / paddle_flight_dumps_total
+"""
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# serving fault kinds always exported (zero-filled) so the label set is
+# stable for dashboards that join across replicas
+_FAULT_KINDS = (
+    "restarts", "restarted_requests", "deadline_miss", "rejected_deadline",
+    "cancelled", "nonfinite",
+)
+
+
+def _escape(v):
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_value(v):
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return format(float(v), ".10g")
+
+
+class _Exposition:
+    """Accumulates samples; emits HELP/TYPE once per metric name."""
+
+    def __init__(self, base_labels=None):
+        self.base = dict(base_labels or {})
+        self.lines = []
+        self._seen = set()
+
+    def add(self, name, value, help_text, mtype="counter", labels=None):
+        if name not in self._seen:
+            self._seen.add(name)
+            self.lines.append(f"# HELP {name} {help_text}")
+            self.lines.append(f"# TYPE {name} {mtype}")
+        merged = dict(self.base)
+        merged.update(labels or {})
+        if merged:
+            inner = ",".join(
+                f'{k}="{_escape(v)}"' for k, v in sorted(merged.items())
+            )
+            self.lines.append(f"{name}{{{inner}}} {_fmt_value(value)}")
+        else:
+            self.lines.append(f"{name} {_fmt_value(value)}")
+
+    def text(self):
+        return "\n".join(self.lines) + "\n"
+
+
+def _pctl(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def render(labels=None):
+    """Render every counter family as Prometheus text.
+
+    ``labels`` (e.g. ``{"replica": "127.0.0.1:8866"}``) is applied to every
+    sample.  Pure host-side reads; safe to scrape a live engine.
+    """
+    from .. import profiler as _prof
+
+    exp = _Exposition(labels)
+    snap = _prof.metrics_snapshot()
+
+    g = snap["step"]
+    exp.add("paddle_train_steps_total", g["steps"],
+            "training steps recorded by record_step")
+    exp.add("paddle_train_dispatch_seconds_total", g["dispatch_s"],
+            "host seconds spent dispatching training steps")
+    exp.add("paddle_train_host_blocked_seconds_total", g["host_blocked_s"],
+            "host seconds blocked on the device (backpressure + sync)")
+    exp.add("paddle_train_wall_seconds_total", g["wall_s"],
+            "wall seconds across recorded training steps")
+    exp.add("paddle_train_inflight_max", g["inflight_max"],
+            "peak in-flight steps in the async ring", "gauge")
+
+    g = snap["serving"]
+    exp.add("paddle_serving_requests_total", g["requests"],
+            "finished generation requests")
+    exp.add("paddle_serving_tokens_total", g["tokens"],
+            "generated tokens across finished requests")
+    exp.add("paddle_serving_ticks_total", g["ticks"],
+            "engine decode scheduler ticks")
+    exp.add("paddle_serving_busy_seconds_total", g["busy_s"],
+            "summed decode-step wall seconds (the tokens/s busy window)")
+    ttfts = sorted(g["ttfts_s"])
+    for q in (0.5, 0.95):
+        exp.add("paddle_serving_ttft_seconds", _pctl(ttfts, q),
+                "time to first token quantiles over the retained window",
+                "gauge", {"quantile": str(q)})
+    ticks = g["ticks"] or 1
+    exp.add("paddle_serving_occupancy_mean", g["occupancy_sum"] / ticks,
+            "mean fraction of KV slots active per tick", "gauge")
+    exp.add("paddle_serving_occupancy_peak", g["occupancy_peak"],
+            "peak fraction of KV slots active", "gauge")
+    exp.add("paddle_serving_queue_depth_max", g["queue_depth_max"],
+            "peak admission-queue depth", "gauge")
+    faults = dict(g["faults"])
+    for kind in _FAULT_KINDS:
+        faults.setdefault(kind, 0)
+    for kind in sorted(faults):
+        exp.add("paddle_serving_faults_total", faults[kind],
+                "serving fault-domain events by kind", "counter",
+                {"kind": kind})
+
+    g = snap["paging"]
+    exp.add("paddle_paging_prefix_hits_total", g["prefix_hits"],
+            "admission-time prefix-cache hits")
+    exp.add("paddle_paging_prefix_misses_total", g["prefix_misses"],
+            "admission-time prefix-cache misses")
+    exp.add("paddle_paging_prefill_tokens_saved_total",
+            g["prefill_tokens_saved"],
+            "prompt tokens whose prefill was skipped via cached prefixes")
+    exp.add("paddle_paging_cow_copies_total", g["cow_copies"],
+            "copy-on-write page copies for new prefix readers")
+    exp.add("paddle_paging_cache_evictions_total", g["cache_evictions"],
+            "prefix-cache page evictions")
+    exp.add("paddle_paging_cache_commits_total", g["cache_commits"],
+            "prompt page sets committed to the prefix cache")
+    exp.add("paddle_paging_pages_used_peak", g["pages_used_peak"],
+            "peak pages in use in the paged-KV pool", "gauge")
+    exp.add("paddle_paging_pages_total", g["pages_total"],
+            "total pages in the paged-KV pool", "gauge")
+
+    g = snap["router"]
+    for key, name in (
+        ("requests", "paddle_router_requests_total"),
+        ("retries", "paddle_router_retries_total"),
+        ("failovers", "paddle_router_failovers_total"),
+        ("breaker_trips", "paddle_router_breaker_trips_total"),
+        ("breaker_half_open", "paddle_router_breaker_half_open_total"),
+        ("breaker_closes", "paddle_router_breaker_closes_total"),
+        ("hedges", "paddle_router_hedges_total"),
+        ("hedge_wins", "paddle_router_hedge_wins_total"),
+        ("brownout_sheds", "paddle_router_brownout_sheds_total"),
+        ("deadline_sheds", "paddle_router_deadline_sheds_total"),
+        ("no_replica", "paddle_router_no_replica_total"),
+    ):
+        exp.add(name, g.get(key, 0), f"router events: {key}")
+    for rid, state in sorted(g["replica_states"].items()):
+        exp.add("paddle_router_replica_state", 1,
+                "last observed state per replica (1 = current state)",
+                "gauge", {"replica": rid, "state": state})
+
+    for reason, n in sorted(snap["flash_fallbacks"].items()):
+        exp.add("paddle_flash_fallbacks_total", n,
+                "flash-attention Pallas->XLA fallbacks by reason",
+                "counter", {"reason": reason})
+
+    try:
+        from ..analysis import sanitizer as _san
+        for key, n in sorted(_san.counters().items()):
+            exp.add(f"paddle_sanitizer_{key}_total", n,
+                    "runtime trace/sync sanitizer counters")
+    except Exception:
+        pass
+
+    from . import flight, trace
+    ts = trace.stats()
+    exp.add("paddle_obs_spans_recorded_total", ts["spans_recorded"],
+            "spans recorded into the trace buffer")
+    exp.add("paddle_obs_spans_dropped_total", ts["spans_dropped"],
+            "spans evicted from the bounded trace buffer")
+    exp.add("paddle_obs_spans_buffered", ts["spans_buffered"],
+            "spans currently buffered", "gauge")
+    fs = flight.stats()
+    exp.add("paddle_flight_events_total", fs["events_total"],
+            "events recorded into the flight-recorder ring")
+    exp.add("paddle_flight_dumps_total", fs["dumps_total"],
+            "flight-recorder JSONL dumps written")
+
+    return exp.text()

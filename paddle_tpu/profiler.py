@@ -98,10 +98,14 @@ def record_step(dispatch_s=0.0, host_blocked_s=0.0, inflight=0, wall_s=0.0):
             g["inflight_max"] = inflight
 
 
+def _reset_step_locked():
+    for k in _step_gauges:
+        _step_gauges[k] = 0 if isinstance(_step_gauges[k], int) else 0.0
+
+
 def reset_step_breakdown():
     with _counters_lock:
-        for k in _step_gauges:
-            _step_gauges[k] = 0 if isinstance(_step_gauges[k], int) else 0.0
+        _reset_step_locked()
 
 
 def step_breakdown():
@@ -191,14 +195,17 @@ def record_serving_tick(occupancy, queue_depth, busy_s=0.0):
             g["queue_depth_max"] = int(queue_depth)
 
 
+def _reset_serving_locked():
+    _serving_gauges.update(
+        requests=0, tokens=0, ttfts_s=[], busy_s=0.0, ticks=0,
+        occupancy_sum=0.0, occupancy_peak=0.0, queue_depth_sum=0,
+        queue_depth_max=0, faults={},
+    )
+
+
 def reset_serving():
     with _counters_lock:
-        g = _serving_gauges
-        g.update(
-            requests=0, tokens=0, ttfts_s=[], busy_s=0.0, ticks=0,
-            occupancy_sum=0.0, occupancy_peak=0.0, queue_depth_sum=0,
-            queue_depth_max=0, faults={},
-        )
+        _reset_serving_locked()
 
 
 # ---------------------------------------------------------------------------
@@ -244,6 +251,40 @@ def reset_flash_fallbacks():
         _flash_fallbacks.clear()
 
 
+def reset():
+    """Zero EVERY counter family (step, serving, paging, router, flash
+    fallbacks) in one critical section.  bench.py calls this between legs
+    so one leg's router/serving gauges can't leak into the next leg's
+    printed summary; the per-family reset_*() helpers remain for callers
+    that want to keep the others."""
+    with _counters_lock:
+        _reset_step_locked()
+        _reset_serving_locked()
+        _reset_paging_locked()
+        _reset_router_locked()
+        _flash_fallbacks.clear()
+
+
+def metrics_snapshot():
+    """Raw one-lock snapshot of every gauge family for the /metrics
+    renderer (paddle_tpu.obs.metrics).  Unlike the *_summary() helpers this
+    never omits zero-valued counters, so exported metric names are stable
+    whether or not traffic has flowed yet."""
+    with _counters_lock:
+        serving = dict(_serving_gauges)
+        serving["ttfts_s"] = list(serving["ttfts_s"])
+        serving["faults"] = dict(serving["faults"])
+        router = dict(_router_gauges)
+        router["replica_states"] = dict(router["replica_states"])
+        return {
+            "step": dict(_step_gauges),
+            "serving": serving,
+            "paging": dict(_paging_gauges),
+            "router": router,
+            "flash_fallbacks": dict(_flash_fallbacks),
+        }
+
+
 def record_prefix_lookup(hit, tokens_saved=0, cow_copies=0):
     """One admission-time prefix-cache lookup: whether any cached prefix was
     reused, how many prompt tokens skipped prefill, and how many shared
@@ -276,11 +317,14 @@ def record_paging_tick(pages_used, pages_total):
             g["pages_used_peak"] = int(pages_used)
 
 
+def _reset_paging_locked():
+    for k in _paging_gauges:
+        _paging_gauges[k] = 0
+
+
 def reset_paging():
     with _counters_lock:
-        g = _paging_gauges
-        for k in g:
-            g[k] = 0
+        _reset_paging_locked()
 
 
 def paging_summary():
@@ -346,11 +390,14 @@ def record_router_replica_state(replica_id, state):
         _router_gauges["replica_states"][str(replica_id)] = str(state)
 
 
+def _reset_router_locked():
+    for k in _router_gauges:
+        _router_gauges[k] = {} if k == "replica_states" else 0
+
+
 def reset_router():
     with _counters_lock:
-        g = _router_gauges
-        for k in g:
-            g[k] = {} if k == "replica_states" else 0
+        _reset_router_locked()
 
 
 def router_summary():

@@ -40,6 +40,8 @@ import urllib.request
 
 from .. import profiler as _prof
 from ..framework import core as _core
+from ..obs import flight as _flight
+from ..obs import trace as _obs
 
 
 class ReplicaTransportError(RuntimeError):
@@ -160,6 +162,7 @@ class Replica:
                     ok = True
         if half_opened:
             _prof.record_router_event("breaker_half_open")
+            _flight.record("breaker", f"{self.rid} open -> half_open (trial)")
         return ok
 
     def record_success(self, latency_s=None):
@@ -179,6 +182,7 @@ class Replica:
                 )
         if closed:
             _prof.record_router_event("breaker_closes")
+            _flight.record("breaker", f"{self.rid} -> closed")
 
     def record_failure(self, reason=""):
         """A sick-replica signal (transport failure, failed probe, engine
@@ -188,6 +192,7 @@ class Replica:
         now = time.monotonic()
         with self._mu:
             self._fails += 1
+            fails = self._fails
             self._trial_inflight = False
             if self._breaker == "half_open" or (
                 self._breaker == "closed" and self._fails >= self.breaker_threshold
@@ -197,6 +202,10 @@ class Replica:
                 tripped = True
         if tripped:
             _prof.record_router_event("breaker_trips")
+            _flight.record(
+                "breaker", f"{self.rid} -> open: {reason}",
+                fails=fails, cooldown_s=self.breaker_cooldown,
+            )
 
     # -- probing -------------------------------------------------------------
 
@@ -259,13 +268,16 @@ class Replica:
 
     # -- transport -----------------------------------------------------------
 
-    def post_generate(self, payload, remaining_s=None, timeout=None):
+    def post_generate(self, payload, remaining_s=None, timeout=None,
+                      trace=None):
         """One /generate dispatch.  Forwards the remaining deadline budget
         as X-Deadline-Ms (the hop contract serve() decodes back into
-        `EngineRequest.deadline_s`).  Returns (status, body, headers,
-        latency_s) for ANY complete HTTP response — typed upstream errors
-        come back as their status + JSON, the router decides on `retriable`.
-        Raises ReplicaTransportError when the connection dies."""
+        `EngineRequest.deadline_s`) and the trace context as X-Trace-Id /
+        X-Parent-Span (`trace` is the router's `(trace_id, forward_span_id)`
+        pair).  Returns (status, body, headers, latency_s) for ANY complete
+        HTTP response — typed upstream errors come back as their status +
+        JSON, the router decides on `retriable`.  Raises
+        ReplicaTransportError when the connection dies."""
         from ..fault import injection as _inj
 
         # an armed router.replica.hang stands in for a wedged connection:
@@ -278,6 +290,10 @@ class Replica:
         )
         if remaining_s is not None:
             req.add_header("X-Deadline-Ms", str(int(remaining_s * 1e3)))
+        if trace is not None:
+            req.add_header(_obs.HDR_TRACE, trace[0])
+            if trace[1]:
+                req.add_header(_obs.HDR_PARENT, trace[1])
         if timeout is None:
             timeout = (remaining_s + 5.0) if remaining_s is not None else 600.0
         t0 = time.monotonic()

@@ -58,6 +58,9 @@ import time
 
 from .. import profiler as _prof
 from ..framework import core as _core
+from ..obs import flight as _flight
+from ..obs import metrics as _obs_metrics
+from ..obs import trace as _obs
 from .replica import Replica, ReplicaTransportError
 
 
@@ -225,11 +228,21 @@ class Router:
 
     # -- routing -------------------------------------------------------------
 
-    def handle_generate(self, payload, deadline_ms=None):
+    def handle_generate(self, payload, deadline_ms=None, trace=None):
         """Route one /generate body.  Returns (status, body, headers);
         every request resolves exactly once — a success from exactly one
-        replica, or ONE typed error."""
+        replica, or ONE typed error.
+
+        `trace` is the client hop's `(trace_id, parent_span_id)` from
+        ``X-Trace-Id``/``X-Parent-Span`` (or None: the router is the first
+        hop and mints the trace id).  The whole handle is recorded as the
+        ``router.admit`` root span; error bodies carry the trace id even
+        when span recording is off."""
         _prof.record_router_event("requests")
+        tid = trace[0] if trace else _obs.new_trace_id()
+        client_sid = trace[1] if trace else None
+        admit_sid = _obs.new_span_id()  # pre-minted: children parent on it
+        t_adm = time.perf_counter()
         deadline_t = (
             time.monotonic() + float(deadline_ms) / 1e3
             if deadline_ms is not None else None
@@ -240,17 +253,38 @@ class Router:
                 self._inflight += 1
         if not admitted:
             _prof.record_router_event("brownout_sheds")
-            ra = self._clamp_retry_after(self.healthiest_retry_after(), deadline_t)
-            return self._error(
-                503, "RouterOverloaded", "router admission gate full", True, ra
+            _flight.record(
+                "admission", "router gate full (brownout shed)",
+                trace_id=tid, max_inflight=self.max_inflight,
             )
+            ra = self._clamp_retry_after(self.healthiest_retry_after(), deadline_t)
+            out = self._error(
+                503, "RouterOverloaded", "router admission gate full", True,
+                ra, trace_id=tid,
+            )
+            _obs.record(
+                "router.admit", tid, t0=t_adm, t1=time.perf_counter(),
+                span_id=admit_sid, parent_id=client_sid, status="error",
+                error="RouterOverloaded",
+            )
+            return out
         try:
-            return self._dispatch(payload, deadline_t)
+            status, body, headers = self._dispatch(
+                payload, deadline_t, (tid, admit_sid)
+            )
         finally:
             with self._mu:
                 self._inflight -= 1
+        _obs.record(
+            "router.admit", tid, t0=t_adm, t1=time.perf_counter(),
+            span_id=admit_sid, parent_id=client_sid,
+            status="ok" if status == 200 else "error", http_status=status,
+            error=None if status == 200 else (body or {}).get("type"),
+        )
+        return status, body, headers
 
-    def _dispatch(self, payload, deadline_t):
+    def _dispatch(self, payload, deadline_t, trace):
+        tid, admit_sid = trace
         tried = set()
         attempt = 0
         prev_rid = None
@@ -263,6 +297,7 @@ class Router:
                 return self._error(
                     504, "DeadlineExhausted",
                     "deadline spent before a replica answered", False,
+                    trace_id=tid,
                 )
             if remaining is not None:
                 # brownout: shed over-deadline work FIRST — when every ready
@@ -272,33 +307,48 @@ class Router:
                 drains = self._ready_drains()
                 if drains and min(drains) > remaining:
                     _prof.record_router_event("brownout_sheds")
+                    _flight.record(
+                        "admission", "deadline-infeasible shed",
+                        trace_id=tid, best_drain_s=round(min(drains), 3),
+                        remaining_s=round(remaining, 3),
+                    )
                     return self._error(
                         504, "DeadlineUnattainable",
                         f"no replica can meet the deadline (best drain "
                         f"estimate {min(drains):.2f}s > remaining "
                         f"{remaining:.2f}s)", False, retry_after=min(drains),
+                        trace_id=tid,
                     )
+            t_pick = time.perf_counter()
             rep = self.pick(exclude=tried)
             if rep is None and tried:
                 # every distinct replica was tried; with budget left, allow
                 # a second pass (a restarted replica may be back)
                 tried = set()
                 rep = self.pick()
+            _obs.record(
+                "router.pick", tid, t0=t_pick, t1=time.perf_counter(),
+                parent_id=admit_sid, attempt=attempt,
+                picked=rep.rid if rep is not None else None,
+                status="ok" if rep is not None else "error",
+            )
             if rep is None:
                 _prof.record_router_event("no_replica")
+                _flight.record("admission", "no ready replica", trace_id=tid)
                 ra = self._clamp_retry_after(
                     self.healthiest_retry_after(), deadline_t
                 )
                 return self._error(
                     503, "NoReadyReplica",
                     "no ready replica (all down, draining, or breaker-open)",
-                    True, ra,
+                    True, ra, trace_id=tid,
                 )
             if attempt > 0:
                 _prof.record_router_event("retries")
                 if rep.rid != prev_rid:
                     _prof.record_router_event("failovers")
-            outcome = self._send_hedged(rep, payload, remaining)
+            outcome = self._send_hedged(rep, payload, remaining, trace,
+                                        attempt=attempt)
             status, body, headers, retriable = outcome
             if status == 200:
                 return 200, body, headers
@@ -314,6 +364,7 @@ class Router:
                     return self._error(
                         504, "DeadlineExhausted",
                         "deadline spent during failover", False,
+                        trace_id=tid,
                     )
                 delay = min(delay, remaining / 2)
             time.sleep(delay)
@@ -325,14 +376,27 @@ class Router:
             jitter = 0.5 + self._rng.random()
         return self.retry_backoff * (2 ** attempt) * jitter
 
-    def _send(self, rep, payload, remaining_s):
+    def _send(self, rep, payload, remaining_s, trace, attempt=0):
         """One dispatch attempt.  Returns (status, body, headers, retriable)
-        and folds the outcome into the replica's breaker/latency state."""
+        and folds the outcome into the replica's breaker/latency state.
+
+        The ``replica.forward`` span id is minted BEFORE the HTTP call so it
+        can ride ``X-Parent-Span`` — the replica's ``serve.handle`` span
+        parents on this attempt, and a dead attempt still leaves an
+        ``aborted`` span joining the failure to the surviving retry."""
+        tid, admit_sid = trace
+        fwd_sid = _obs.new_span_id()
+        t_fwd = time.perf_counter()
         try:
             status, body, headers, latency = rep.post_generate(
-                payload, remaining_s
+                payload, remaining_s, trace=(tid, fwd_sid)
             )
         except ReplicaTransportError as e:
+            _obs.record(
+                "replica.forward", tid, t0=t_fwd, t1=time.perf_counter(),
+                span_id=fwd_sid, parent_id=admit_sid, status="aborted",
+                replica=rep.rid, attempt=attempt, error=f"{e}",
+            )
             rep.record_failure(str(e))
             if e.response_started:
                 # bytes already reached us: a retry could double-deliver
@@ -340,13 +404,20 @@ class Router:
                 st, bd, hd = self._error(
                     502, "UpstreamIncomplete",
                     f"replica {rep.rid} died mid-response: {e}", False,
+                    trace_id=tid,
                 )
                 return st, bd, hd, False
             st, bd, hd = self._error(
                 503, "ReplicaUnreachable",
-                f"replica {rep.rid} unreachable: {e}", True,
+                f"replica {rep.rid} unreachable: {e}", True, trace_id=tid,
             )
             return st, bd, hd, True
+        _obs.record(
+            "replica.forward", tid, t0=t_fwd, t1=time.perf_counter(),
+            span_id=fwd_sid, parent_id=admit_sid,
+            status="ok" if status == 200 else "error",
+            replica=rep.rid, attempt=attempt, http_status=status,
+        )
         if status == 200:
             rep.record_success(latency)
             return status, body, headers, False
@@ -362,18 +433,19 @@ class Router:
             rep.record_success(latency)
         return status, body, headers, retriable
 
-    def _send_hedged(self, rep, payload, remaining_s):
+    def _send_hedged(self, rep, payload, remaining_s, trace, attempt=0):
         """Dispatch with optional hedging: when the primary has not answered
         after `hedge_s`, duplicate the (zero-token, pure) request onto a
         second replica; the first complete response wins."""
         if self.hedge_s <= 0:
-            return self._send(rep, payload, remaining_s)
+            return self._send(rep, payload, remaining_s, trace,
+                              attempt=attempt)
         results = []
         results_mu = threading.Lock()
         first_done = threading.Event()
 
         def _run(r):
-            out = self._send(r, payload, remaining_s)
+            out = self._send(r, payload, remaining_s, trace, attempt=attempt)
             with results_mu:
                 results.append((out, r))
             first_done.set()
@@ -454,15 +526,19 @@ class Router:
         return ra
 
     @staticmethod
-    def _error(status, err_type, msg, retriable, retry_after=None):
+    def _error(status, err_type, msg, retriable, retry_after=None,
+               trace_id=None):
         headers = {}
         if retry_after:
             headers["Retry-After"] = str(max(1, int(retry_after + 0.5)))
+        if trace_id:
+            headers[_obs.HDR_TRACE] = trace_id
         return status, {
             "error": msg,
             "type": err_type,
             "retriable": bool(retriable),
             "retry_after_s": retry_after or 0,
+            "trace_id": trace_id,
         }, headers
 
 
@@ -471,9 +547,13 @@ def serve_router(replicas, port=8900, host="127.0.0.1", block=True, probe=True):
 
     - GET  /health   -> 200
     - GET  /healthz  -> fleet snapshot (200 when >= 1 replica is ready)
+    - GET  /metrics  -> Prometheus text exposition (role="router" label)
+    - GET  /trace/<id> -> the router-side span tree for one trace id
     - POST /generate -> routed with failover + deadline propagation; the
       client's deadline arrives as `X-Deadline-Ms` (or body `deadline_s`),
-      and each upstream hop receives only the remaining budget.
+      and each upstream hop receives only the remaining budget.  Trace
+      context (`X-Trace-Id`/`X-Parent-Span`) is joined or minted and
+      forwarded to the chosen replica; responses carry `X-Trace-Id`.
 
     Returns the ThreadingHTTPServer with `.router` attached; non-blocking
     callers get a daemon thread running `serve_forever()`.
@@ -504,6 +584,24 @@ def serve_router(replicas, port=8900, host="127.0.0.1", block=True, probe=True):
             elif self.path == "/healthz":
                 h = router.healthz()
                 self._reply(200 if h["status"] == "ready" else 503, h)
+            elif self.path == "/metrics":
+                # bound address, not the port argument (0 = ephemeral)
+                bh, bp = self.server.server_address[:2]
+                body = _obs_metrics.render(
+                    labels={"replica": f"{bh}:{bp}", "role": "router"}
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", _obs_metrics.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path.startswith("/trace/"):
+                tid = self.path[len("/trace/"):]
+                roots = _obs.tree(tid)
+                if roots:
+                    self._reply(200, {"trace_id": tid, "spans": roots})
+                else:
+                    self._reply(404, {"error": f"no spans buffered for trace {tid!r}"})
             else:
                 self._reply(404, {"error": "use POST /generate"})
 
@@ -525,11 +623,12 @@ def serve_router(replicas, port=8900, host="127.0.0.1", block=True, probe=True):
             # replicas see only the remaining budget via X-Deadline-Ms
             payload.pop("deadline_s", None)
             status, body, headers = router.handle_generate(
-                payload, deadline_ms=deadline_ms
+                payload, deadline_ms=deadline_ms,
+                trace=_obs.ctx_from_headers(self.headers),
             )
             self._reply(status, body, headers={
                 k: v for k, v in headers.items()
-                if k.lower() in ("retry-after",)
+                if k.lower() in ("retry-after", "x-trace-id")
             })
 
     server = ThreadingHTTPServer((host, port), Handler)

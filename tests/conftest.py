@@ -38,6 +38,7 @@ _SANITIZED_MODULES = {
     "test_paged_kv",
     "test_serving_fault",
     "test_async_pipeline",
+    "test_observability",
 }
 
 

@@ -353,6 +353,59 @@ def test_failover_retries_on_survivor_bit_identical(model):
         _stop_server(srv_b)
 
 
+def test_failover_leaves_single_trace_with_aborted_hop(model):
+    """ISSUE 10 drill: kill replica A between the probe and the request.
+    The whole two-hop story — dead attempt AND surviving retry — must land
+    under ONE trace id: an ``aborted`` replica.forward for A, an ``ok`` one
+    for B with the survivor's serve.handle parented on it."""
+    from paddle_tpu.obs import trace as obs_trace
+
+    srv_a, eng_a, url_a = _replica_server(model)
+    srv_b, eng_b, url_b = _replica_server(model)
+    router = Router([url_a, url_b], probe_interval=3600, retry_backoff=0.01)
+    paddle.set_flags({"FLAGS_trace": True})
+    obs_trace.reset()
+    try:
+        router.probe_once()  # both ready; ties break toward index 0
+        _stop_server(srv_a)  # A dies AFTER the probe marked it ready
+        p = _prompt(6, seed=3)
+        status, body, _ = router.handle_generate(
+            {"input_ids": p.tolist(), "max_new_tokens": 4}
+        )
+        assert status == 200
+        assert np.array_equal(body["tokens"], _ref(model, p, 4))
+
+        tids = {s["trace_id"] for s in obs_trace.spans()}
+        assert len(tids) == 1  # ONE trace spans the failure and the retry
+        tid = tids.pop()
+        fwd = [s for s in obs_trace.spans(tid)
+               if s["name"] == "replica.forward"]
+        assert [s["status"] for s in fwd] == ["aborted", "ok"]
+        assert fwd[0]["attrs"]["replica"] == "r0"
+        assert fwd[0]["attrs"]["error"]  # why the hop died
+        assert fwd[1]["attrs"]["replica"] == "r1"
+        assert fwd[1]["attrs"]["http_status"] == 200
+        # the survivor's serve() hop joined the trace via X-Parent-Span,
+        # parented on ITS forward attempt (not the aborted one)
+        handles = [s for s in obs_trace.spans(tid)
+                   if s["name"] == "serve.handle"]
+        assert len(handles) == 1
+        assert handles[0]["parent_id"] == fwd[1]["span_id"]
+        # one admit root owns one pick per attempt
+        admit = [s for s in obs_trace.spans(tid)
+                 if s["name"] == "router.admit"]
+        assert len(admit) == 1 and admit[0]["status"] == "ok"
+        picks = [s for s in obs_trace.spans(tid)
+                 if s["name"] == "router.pick"]
+        assert len(picks) == 2
+        assert all(s["parent_id"] == admit[0]["span_id"] for s in picks)
+    finally:
+        paddle.set_flags({"FLAGS_trace": False})
+        obs_trace.reset()
+        router.stop()
+        _stop_server(srv_b)
+
+
 def test_hedged_dispatch_wins_over_hung_replica(model):
     srv_a, eng_a, url_a = _replica_server(model)
     srv_b, eng_b, url_b = _replica_server(model)
@@ -542,13 +595,26 @@ def test_router_gauges_in_profiler_summary(model, capsys):
 
 
 @pytest.mark.slow
-def test_kill9_chaos_drill_exactly_once(model, tmp_path):
+def test_kill9_chaos_drill_exactly_once(model, tmp_path, monkeypatch):
     """Two router-managed subprocess replicas (launch Container topology).
     Under Poisson load, the injected router.replica.kill SIGKILLs one
     replica.  Every submitted request must resolve exactly once — retried
     on the survivor or failed typed — and every 200 must be bit-identical
     to an undisturbed run.  Afterwards a rolling restart revives the dead
-    replica through the Container respawn path and the fleet is whole."""
+    replica through the Container respawn path and the fleet is whole.
+
+    ISSUE 10 rides the drill: tracing is on in every process, so the kill
+    must leave a single trace joining the dead hop to its surviving retry,
+    and the SIGTERM drains plus the breaker transition must land in
+    flight-recorder dumps under $PADDLE_OBS_DIR."""
+    from paddle_tpu.obs import flight, trace as obs_trace
+
+    obs_dir = tmp_path / "flightrec"
+    monkeypatch.setenv("PADDLE_OBS_DIR", str(obs_dir))
+    monkeypatch.setenv("PADDLE_TRACE", "1")  # subprocess replicas inherit
+    paddle.set_flags({"FLAGS_trace": True})
+    obs_trace.reset()
+    flight.reset()
     procs = [
         ReplicaProcess(i, _free_port(), log_dir=str(tmp_path / "logs")).start()
         for i in range(2)
@@ -607,6 +673,52 @@ def test_kill9_chaos_drill_exactly_once(model, tmp_path):
         killed = [rp for rp in procs if not rp.alive()]
         assert len(killed) == 1  # the fault killed exactly one replica
 
+        # freeze probing and replay the production race the trace exists to
+        # explain: the router acts on STALE health state — it still believes
+        # the SIGKILLed replica is ready — so one request must leave BOTH
+        # hops in one trace: the aborted forward and the survivor's retry
+        router.stop()
+        dead_rep = next(r for r in reps if not r.process.alive())
+        live_rep = next(r for r in reps if r.process.alive())
+        dead_rep._note_healthz({"status": "ready", "queue_depth": 0,
+                                "active_slots": 0, "drain_estimate_s": 0.0})
+        live_rep._note_healthz({"status": "ready", "queue_depth": 1,
+                                "active_slots": 1, "drain_estimate_s": 0.5})
+        p = _prompt(6, seed=55)
+        status, body, _ = router.handle_generate(
+            {"input_ids": p.tolist(), "max_new_tokens": 4}
+        )
+        assert status == 200
+        assert np.array_equal(body["tokens"], _ref(model, p, 4))
+        by_tid = {}
+        for s in obs_trace.spans():
+            if s["name"] == "replica.forward":
+                by_tid.setdefault(s["trace_id"], []).append(s)
+        joined = [
+            hops for hops in by_tid.values()
+            if any(h["status"] == "aborted" for h in hops)
+            and any(h["status"] == "ok" for h in hops)
+        ]
+        assert joined, "no trace joins the dead hop to its surviving retry"
+        hops = joined[-1]  # the stale-state request is the newest
+        dead = next(h for h in hops if h["status"] == "aborted")
+        live = next(h for h in hops if h["status"] == "ok")
+        assert dead["attrs"]["replica"] == dead_rep.rid
+        assert live["attrs"]["replica"] == live_rep.rid
+
+        # the breaker transition reached the flight ring; a post-mortem
+        # dump carries it (one JSON object per line, header first)
+        dump_path = flight.dump("chaos-drill")
+        assert dump_path and str(obs_dir) in dump_path
+        with open(dump_path) as f:
+            lines = [json.loads(ln) for ln in f]
+        assert lines[0]["kind"] == "header"
+        assert lines[0]["reason"] == "chaos-drill"
+        assert any(
+            e.get("kind") == "breaker" and "open" in e.get("detail", "")
+            for e in lines[1:]
+        ), "flight dump is missing the breaker transition"
+
         # rolling restart revives the dead replica via Container respawn
         # and re-admits it only after /healthz reports ready
         report = router.rolling_restart(grace=10.0, ready_timeout=180.0)
@@ -618,7 +730,15 @@ def test_kill9_chaos_drill_exactly_once(model, tmp_path):
         )
         assert status == 200
         assert np.array_equal(body["tokens"], _ref(model, p, 4))
+
+        # the rolling restart's SIGTERM drain dumped the survivor's flight
+        # ring into $PADDLE_OBS_DIR from inside the subprocess
+        drains = [p_ for p_ in obs_dir.iterdir() if "serve-drain" in p_.name]
+        assert drains, "SIGTERM drain left no flight-recorder dump"
     finally:
+        paddle.set_flags({"FLAGS_trace": False})
+        obs_trace.reset()
+        flight.reset()
         router.stop()
         for rp in procs:
             rp.terminate()

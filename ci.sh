@@ -54,6 +54,19 @@ if [ "$MODE" = "chaos-serve" ]; then
       python -m pytest \
       "tests/test_paged_kv.py::test_warm_restart_preserves_prefix_cache_no_recompile" \
       -q -p no:cacheprovider
+  echo "== fault drills under speculation (ISSUE 11) =="
+  # rerun the deterministic serving-fault core with the engine speculating
+  # (FLAGS_serve_spec_k=3, env-var override): watchdog warm restart and NaN
+  # isolation must hold when the decode path is the batched verify step —
+  # restart drops drafter state with the slot table, the replayed request
+  # is still bit-identical, and a poisoned slot's NaN cannot leak into a
+  # neighbour through the [slots, k+1] verify forward
+  timeout -k 30 600 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      FLAGS_serve_spec_k=3 \
+      python -m pytest \
+      "tests/test_serving_fault.py::test_prefill_hang_watchdog_restart_bit_identical" \
+      "tests/test_serving_fault.py::test_decode_nan_poisons_only_target_slot" \
+      -q -p no:cacheprovider
   echo "CHAOS-SERVE OK"
   exit 0
 fi
@@ -147,6 +160,19 @@ PAGED_TESTS=(tests/test_paged_kv.py::test_paged_matches_dense_mixed_traffic
 [ "$MODE" != "fast" ] && PAGED_TESTS=(tests/test_paged_kv.py)
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python -m pytest "${PAGED_TESTS[@]}" -q -p no:cacheprovider
+
+echo "== speculative-decoding smoke (ISSUE 11 acceptance subset) =="
+# both tiers: n-gram draft + batched verify emits token-identical greedy
+# output vs the plain engine, and acceptance-rate churn (joins, finishes,
+# per-request caps, hits AND misses) never grows the compiled set past the
+# single warmed verify executable; fast mode runs that pair, full mode the
+# whole file (EOS right-trim, mixed spec/plain co-batching, warm restart,
+# drain-estimate EWMA, /metrics + trace-span surfaces)
+SPEC_TESTS=(tests/test_spec_decode.py::test_spec_greedy_token_identical_to_plain
+            tests/test_spec_decode.py::test_zero_recompiles_under_acceptance_churn)
+[ "$MODE" != "fast" ] && SPEC_TESTS=(tests/test_spec_decode.py)
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python -m pytest "${SPEC_TESTS[@]}" -q -p no:cacheprovider
 
 echo "== serving fault drills (ISSUE 6 acceptance subset) =="
 # both tiers run the deterministic core of the serving fault domain: the

@@ -493,6 +493,22 @@ define_flag(
     "unshared suffix",
 )
 define_flag(
+    "FLAGS_serve_spec_k", 0,
+    "paged engine: speculative decoding draft length — an n-gram/prompt-"
+    "lookup drafter proposes up to k tokens per greedy slot from the slot's "
+    "own prompt+generated history and the target model verifies all k+1 "
+    "positions in ONE compiled forward (shaped [slots, k+1]; acceptance is "
+    "data, so slot churn still causes zero recompiles).  0 disables "
+    "speculation (the plain one-token decode step).  Per-request 'spec_k' "
+    "clamps below this engine-wide cap",
+)
+define_flag(
+    "FLAGS_serve_spec_ngram", 3,
+    "speculative decoding: longest n-gram the prompt-lookup drafter matches "
+    "against the slot's history (it backs off n..1 and proposes nothing on "
+    "a miss — a prompt shorter than n just drafts from lower orders)",
+)
+define_flag(
     "FLAGS_router_probe_interval", 0.25,
     "serving router: seconds between /healthz probes of each registered "
     "replica (drives live/ready/draining/dead tracking and load gauges)",

@@ -177,7 +177,9 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
     - POST /generate          -> {"tokens": [...]}    (GenerationPredictor or
       ContinuousBatchingEngine; body: {"input_ids": [...] or [[...], ...],
       "max_new_tokens": n, "temperature": t, "eos_token_id": id,
-      "deadline_s": s})
+      "deadline_s": s, "spec_k": k}).  "spec_k" caps the request's
+      speculative draft length below the engine-wide FLAGS_serve_spec_k
+      (0 opts out of speculation; omitted = engine default)
 
     A ContinuousBatchingEngine serves /generate with true continuous
     batching: concurrent requests decode interleaved in the slot pool, each
@@ -346,6 +348,10 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
                                 eos_token_id=req.get("eos_token_id"),
                                 deadline_s=deadline_s,
                                 trace=(self._trace_id, self._handle_sid),
+                                spec_k=(
+                                    None if req.get("spec_k") is None
+                                    else int(req["spec_k"])
+                                ),
                             )
                         )
                 except engine_mod.DeadlineUnattainable as e:

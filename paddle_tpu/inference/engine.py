@@ -73,6 +73,25 @@ Serving fault domain (the serving mirror of the training fault domain):
   traced data for one step; only that request errors, co-batched requests
   are bit-identical to an unpoisoned run), `serve.loop.crash` (kills the
   scheduler thread) — armed via the usual `FLAGS_fault_inject` registry.
+
+Speculative decoding (ISSUE 11, paged engines, FLAGS_serve_spec_k > 0):
+decode is HBM-bandwidth-bound — one token per step leaves the FLOPs idle —
+so the engine drafts k candidate tokens per greedy slot with a host-side
+prompt-lookup `NgramDrafter` (no second model; spec.py) and the target
+model verifies all k+1 positions in ONE compiled forward over the same
+paged arena (`_verify_paged_body`, shaped [slots, k+1]).  Acceptance
+length, proposed tokens, and per-slot draft validity are DATA, so the
+compiled budget grows by exactly one executable (`compile_counts()` gains
+`verify`) and join/finish/recycle still cause zero recompiles.  Greedy
+equivalence is structural: draft i is accepted only while it equals the
+model's own greedy continuation, so output is token-identical to the
+plain engine whatever the drafter proposes — rejected-position KV writes
+land on scratch (page-table redirect) or past the advanced `pos`, where
+the next window overwrites them before anything attends them.  Sampled
+(temp > 0) slots ride the same step at draft length 0, column 0 sampling
+on the plain decode's key schedule.  The drain/admission EWMA consumes
+observed tokens-per-step so Retry-After and DeadlineUnattainable stay
+honest when steps emit >1 token.
 """
 
 from __future__ import annotations
@@ -101,7 +120,8 @@ from ..models.llama import (
     StaticKVCache,
 )
 from ..tensor import Tensor
-from .paging import PagePool, PrefixCache
+from .paging import PagePool, PrefixCache, spec_write_pages
+from .spec import NgramDrafter
 
 logger = logging.getLogger("paddle_tpu")
 
@@ -182,7 +202,7 @@ class EngineRequest:
     {eos, length, timeout, cancelled, restarted, error} — exactly once."""
 
     def __init__(self, rid, prompt, max_new_tokens, temperature, eos_token_id,
-                 on_token, deadline_s=None, trace=None):
+                 on_token, deadline_s=None, trace=None, spec_k=None):
         self.id = int(rid)
         # (trace_id, parent_span_id) from the submitting hop, or None;
         # every engine-stage span for this request parents under it
@@ -191,6 +211,9 @@ class EngineRequest:
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.eos_token_id = eos_token_id
+        # per-request speculation cap: None = engine default, 0 = opt out,
+        # >0 clamps below the engine-wide FLAGS_serve_spec_k
+        self.spec_k = None if spec_k is None else int(spec_k)
         self.on_token = on_token
         self.deadline_s = None if deadline_s is None else float(deadline_s)
         self.tokens = []  # generated ids (includes eos when hit)
@@ -252,7 +275,7 @@ class ContinuousBatchingEngine:
 
     def __init__(self, model, slots=None, max_len=None, prefill_buckets=None,
                  queue_depth=None, seed=0, paged=None, page_size=None,
-                 pool_pages=None, prefix_cache=None):
+                 pool_pages=None, prefix_cache=None, spec_k=None):
         import jax
 
         from .. import jit, to_tensor
@@ -337,6 +360,22 @@ class ContinuousBatchingEngine:
             ]
             self._decode_fn = jit.to_static(self._decode_body)
             self._prefill_fn = jit.to_static(self._prefill_body)
+        # speculative decoding (paged engines only — it rides the page
+        # scatter's scratch redirect for rejected-row safety)
+        sk = int(_fcore.flag("FLAGS_serve_spec_k") if spec_k is None else spec_k)
+        if sk < 0:
+            raise ValueError("spec_k must be >= 0")
+        self.spec_k = sk if self.paged else 0
+        self._spec_on = self.spec_k > 0
+        self._spec_ngram = int(_fcore.flag("FLAGS_serve_spec_ngram"))
+        self._verify_fn = (
+            jit.to_static(self._verify_paged_body) if self._spec_on else None
+        )
+        self._drafters = [None] * self.slots  # per-slot NgramDrafter or None
+        # EWMA of emitted tokens per slot-step (1.0 without speculation) —
+        # the drain estimate divides by it so admission stays honest when
+        # verify steps emit accepted runs
+        self._tok_rate_ewma = 1.0
         self._key = to_tensor(np.asarray(jax.random.PRNGKey(int(seed))))
 
         # runtime-sanitizer bookkeeping: after warmup() the scheduler tick
@@ -494,6 +533,69 @@ class ContinuousBatchingEngine:
         )
         return nxt, new_pos, finite, key
 
+    def _verify_paged_body(self, toks, pos, active, valid_len, temps, poison,
+                           key, tables):
+        """Speculative verify: ONE compiled forward scores k+1 positions per
+        slot.  toks [S, k+1] — column 0 the committed last token (not yet in
+        KV; this window writes it), columns 1..k the host-side prompt-lookup
+        drafts; valid_len [S] counts the committed token plus real drafts
+        (1 == plain decode for that row).  Window row i writes KV at pos+i
+        through the page table and attends j <= pos+i, so greedy[i] is the
+        model's next token after prefix + window[:i+1].  Draft i is accepted
+        iff it equals greedy[i-1] and every earlier draft was (cumulative
+        product), and the emitted run is greedy[0..n_acc] — exactly the
+        tokens one-at-a-time decode would have produced (greedy
+        equivalence; draft quality only moves the acceptance rate).
+        Rejected rows need no rollback: their KV sits past the advanced pos
+        (or on scratch via the table redirect) and the next window rewrites
+        [new_pos, new_pos+k] before anything attends it.  Sampled slots
+        (temp > 0) ride at valid_len 1; column 0 samples on the SAME
+        one-split-per-step key schedule as `_decode_paged_body`.  Returns
+        (out [S,k+1], n_emit [S], new_pos [S], finite [S], key)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.dispatch import apply
+
+        pos_eff = apply(
+            lambda p, a: jnp.where(a, p, 0), [pos, active], name="serve_pos_mask"
+        )
+        views = [PagedDecodeView(a, tables, self.max_len) for a in self._arenas]
+        hidden, _ = self.model.llama(toks, caches=views, pos=pos_eff)
+        logits = self.model.lm_head(hidden)  # [S, k+1, V]
+
+        def f(lg, tk, ky, tp, p, a, vl, po):
+            lgf = lg.astype(jnp.float32)
+            lgf = jnp.where(po[:, None, None], jnp.nan, lgf)
+            greedy = jnp.argmax(lgf, axis=-1).astype(jnp.int32)  # [S, k+1]
+            k1 = greedy.shape[1]
+            drafts_ok = tk[:, 1:] == greedy[:, :-1]
+            considered = (
+                jnp.arange(k1 - 1, dtype=jnp.int32)[None, :] < (vl - 1)[:, None]
+            )
+            acc = jnp.cumprod((drafts_ok & considered).astype(jnp.int32), axis=1)
+            n_acc = acc.sum(axis=1).astype(jnp.int32)
+            n_emit = jnp.where(a, n_acc + 1, 0).astype(jnp.int32)
+            ky, sub = jax.random.split(ky)
+            samp0 = jax.random.categorical(
+                sub, lgf[:, 0] / jnp.maximum(tp, 1e-6)[:, None], axis=-1
+            ).astype(jnp.int32)
+            out = greedy.at[:, 0].set(jnp.where(tp > 0.0, samp0, greedy[:, 0]))
+            # the non-finite watch covers only EMITTED rows: a rejected
+            # draft's logits are discarded, they must not error the slot
+            row_finite = jnp.all(jnp.isfinite(lgf), axis=-1)  # [S, k+1]
+            emit_mask = (
+                jnp.arange(k1, dtype=jnp.int32)[None, :] < n_emit[:, None]
+            )
+            finite = jnp.all(row_finite | ~emit_mask, axis=1) | ~a
+            return out, n_emit, p + n_emit, finite, ky
+
+        out, n_emit, new_pos, finite, key = apply(
+            f, [logits, toks, key, temps, pos, active, valid_len, poison],
+            multi=True, name="serve_verify",
+        )
+        return out, n_emit, new_pos, finite, key
+
     def _prefill_paged_body(self, toks, row_table, true_len, temp, key):
         """_prefill_body for a fresh paged prefill: the prompt attends to
         itself causally (the exact dense-SlotView math — bit-identical first
@@ -584,13 +686,14 @@ class ContinuousBatchingEngine:
 
     def submit(self, input_ids, max_new_tokens=32, temperature=0.0,
                eos_token_id=None, on_token=None, deadline_s=None,
-               trace=None):
+               trace=None, spec_k=None):
         """Enqueue one request (1-D token ids).  Returns an EngineRequest
         handle immediately; raises QueueFull when the admission queue is at
         capacity, DeadlineUnattainable when `deadline_s` cannot beat the
         current queue-drain estimate (deadline-aware admission), and
         EngineUnavailable while draining or after the restart budget is
-        spent."""
+        spent.  `spec_k` caps this request's speculative draft length below
+        the engine-wide FLAGS_serve_spec_k (0 opts out, None = default)."""
         from .. import profiler as _prof
 
         ids = np.asarray(input_ids, np.int32).reshape(-1)
@@ -604,6 +707,8 @@ class ContinuousBatchingEngine:
             raise ValueError("max_new_tokens must be >= 1")
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError("deadline_s must be > 0")
+        if spec_k is not None and int(spec_k) < 0:
+            raise ValueError("spec_k must be >= 0")
         if self._dead:
             raise EngineUnavailable(
                 "engine is dead (restart budget exhausted); restart the server"
@@ -642,6 +747,7 @@ class ContinuousBatchingEngine:
         req = EngineRequest(
             next(self._req_ids), ids, max_new_tokens, temperature,
             eos_token_id, on_token, deadline_s=deadline_s, trace=trace,
+            spec_k=spec_k,
         )
         req._submit_t = time.perf_counter()
         if deadline_s is not None:
@@ -709,6 +815,21 @@ class ContinuousBatchingEngine:
                 self._key,
                 to_tensor(np.zeros((self.slots, self.pages_per_seq), np.int32)),
             )
+            if self._spec_on:
+                # the one extra executable speculation buys: all-inactive
+                # rows aim every window write at scratch page 0
+                _, _, _, _, self._key = self._verify_fn(
+                    to_tensor(np.zeros((self.slots, self.spec_k + 1), np.int32)),
+                    to_tensor(np.zeros(self.slots, np.int32)),
+                    to_tensor(np.zeros(self.slots, bool)),
+                    to_tensor(np.ones(self.slots, np.int32)),
+                    to_tensor(np.zeros(self.slots, np.float32)),
+                    self._poison_zero,
+                    self._key,
+                    to_tensor(
+                        np.zeros((self.slots, self.pages_per_seq), np.int32)
+                    ),
+                )
             with self._mu:
                 self._warm_buckets = set(self.prefill_buckets)
             self._warmed = True
@@ -738,7 +859,8 @@ class ContinuousBatchingEngine:
         (engine restarts included: restart rebinds the same executables).
         Paged engines add chunk_prefill (== buckets warmed) and copy (== 1):
         prefix-cache hits and COW copies ride those executables with zero
-        fresh traces."""
+        fresh traces.  Speculation adds verify (== 1): acceptance churn is
+        data, the [slots, k+1] shape never changes."""
         out = {
             "prefill": self._prefill_fn.trace_count,
             "decode": self._decode_fn.trace_count,
@@ -748,6 +870,9 @@ class ContinuousBatchingEngine:
             out["chunk_prefill"] = self._chunk_fn.trace_count
             out["copy"] = self._copy_fn.trace_count
             out["aot_hits"] += self._chunk_fn.aot_hits + self._copy_fn.aot_hits
+        if self._spec_on:
+            out["verify"] = self._verify_fn.trace_count
+            out["aot_hits"] += self._verify_fn.aot_hits
         return out
 
     @property
@@ -768,9 +893,12 @@ class ContinuousBatchingEngine:
     def estimate_drain_s(self):
         """Rough wall seconds until the current backlog drains: tokens still
         owed to active slots plus tokens requested by queued work, decoded
-        `slots` at a time at the EWMA decode-round wall time.  0 before any
-        traffic (no evidence, admit everything) — feeds deadline-aware
-        admission and the Retry-After header on 503s."""
+        `slots` at a time at the EWMA decode-round wall time, scaled by the
+        EWMA tokens-per-step (speculative steps emit accepted runs — pricing
+        them at 1 token/step would over-reject deadlines and mis-rank this
+        replica in least-loaded routing).  0 before any traffic (no
+        evidence, admit everything) — feeds deadline-aware admission and
+        the Retry-After header on 503s."""
         ew = self._step_ewma_s
         if not ew:
             return 0.0
@@ -782,7 +910,8 @@ class ContinuousBatchingEngine:
             queued = max(0, self._queued_new_tokens)
         if not (active or queued):
             return 0.0
-        return math.ceil((active + queued) / max(1, self.slots)) * ew
+        rate = max(1e-6, self._tok_rate_ewma)
+        return math.ceil((active + queued) / (max(1, self.slots) * rate)) * ew
 
     def _shed_retry_after(self, deadline_s):
         """Retry-After for a QueueFull shed: the drain estimate, clamped by
@@ -829,6 +958,10 @@ class ContinuousBatchingEngine:
             "page_free_frac": round(page_free, 4),
             "prefix_cache_size": len(self._prefix) if self._prefix is not None else 0,
             "decode_ewma_ms": round(ew * 1e3, 3) if ew else 0.0,
+            # observed mean emitted tokens per slot-step (1.0 unless
+            # speculation is accepting drafts) — the factor decode_ewma_ms
+            # must be divided by when comparing replica throughput
+            "tokens_per_step": round(self._tok_rate_ewma, 3),
         }
 
     # -- scheduler ----------------------------------------------------------
@@ -985,6 +1118,10 @@ class ContinuousBatchingEngine:
             self._pos[:] = 0
             self._last_tok[:] = 0
             self._temps[:] = 0.0
+            # drafters rebuild cleanly at re-admission (reset from prompt +
+            # first token) — stale host n-gram state must not outlive the
+            # slot assignment it indexed
+            self._drafters = [None] * self.slots
             self._ep = None  # epoch members were restarted; drop, don't record
             self._dev = None
             self._pending_fetch = []
@@ -1047,6 +1184,7 @@ class ContinuousBatchingEngine:
             self._pos[:] = 0
             self._last_tok[:] = 0
             self._temps[:] = 0.0
+            self._drafters = [None] * self.slots
             self._ep = None
             self._dev = None
             self._pending_fetch = []
@@ -1473,6 +1611,17 @@ class ContinuousBatchingEngine:
             self._pos[s] = L
             self._last_tok[s] = tok
             self._temps[s] = req.temperature
+            if self._spec_on and req.temperature == 0.0 and (
+                req.spec_k is None or req.spec_k > 0
+            ):
+                # greedy slots draft from their own history (prompt + first
+                # token); sampled slots ride the verify step undrafted —
+                # greedy equivalence is the only acceptance rule we prove
+                self._drafters[s] = NgramDrafter(self._spec_ngram).reset(
+                    [int(t) for t in req.prompt] + [tok]
+                )
+            else:
+                self._drafters[s] = None
             req.state = "decoding"
             self._obs_epoch_close()
             self._dev = None  # membership changed: rebuild device loop state
@@ -1486,6 +1635,8 @@ class ContinuousBatchingEngine:
             )
 
     def _decode_once(self, gen):
+        if self._spec_on:
+            return self._decode_once_spec(gen)
         from .. import profiler as _prof
         from .. import to_tensor
 
@@ -1565,6 +1716,147 @@ class ContinuousBatchingEngine:
                 )
         return len(active_idx)
 
+    def _decode_once_spec(self, gen):
+        """One speculative round for every active slot: draft on the host
+        (prompt-lookup, free), verify k+1 positions in ONE compiled dispatch,
+        emit the accepted run.  Shapes are fixed at [slots, spec_k+1] —
+        draft content, validity, and acceptance are data, so acceptance
+        churn and slot churn alike cause zero recompiles.  Unlike the plain
+        path this fetches every step (the next draft needs this step's
+        accepted tokens on the host); the batching the plain path buys with
+        deferred fetches is what speculation replaces — >1 token per sync."""
+        from .. import profiler as _prof
+        from .. import to_tensor
+
+        K1 = self.spec_k + 1
+        with self._mu:
+            self._check_gen(gen)
+            active_idx = [s for s in range(self.slots) if self._slot_req[s] is not None]
+            if not active_idx:
+                return 0
+            t0 = time.perf_counter()
+            if self._dev is None:
+                self._obs_epoch_close()
+                active = np.zeros(self.slots, bool)
+                active[active_idx] = True
+                # spec loop state is (pos, active, temps): tokens rebuild
+                # host-side every step from _last_tok + fresh drafts
+                self._dev = (
+                    to_tensor(self._pos.copy()), to_tensor(active),
+                    to_tensor(self._temps.copy()),
+                )
+                self._tables_t = to_tensor(self._page_table.copy())
+                self._obs_epoch_open(active_idx)
+            pos_t, active_t, temps_t = self._dev
+            key = self._key
+            toks = np.zeros((self.slots, K1), np.int32)
+            vl = np.ones(self.slots, np.int32)
+            proposed = 0
+            for s in active_idx:
+                req = self._slot_req[s]
+                toks[s, 0] = self._last_tok[s]
+                dr = self._drafters[s]
+                if dr is None:
+                    continue  # sampled or spec_k=0 request: plain-decode row
+                # the clamp that keeps every COMMITTED row mapped: at most
+                # remaining-1 drafts, so n_emit never overshoots the length
+                # bound and the last committed row stays < max_len
+                budget = min(
+                    self.spec_k,
+                    self.spec_k if req.spec_k is None else req.spec_k,
+                    req.max_new_tokens - len(req.tokens) - 1,
+                )
+                draft = dr.propose(budget) if budget > 0 else []
+                if draft:
+                    toks[s, 1:1 + len(draft)] = draft
+                    vl[s] = 1 + len(draft)
+                    proposed += len(draft)
+            if self._ep is not None:
+                self._ep["proposed"] += proposed
+            poison_t, poisoned = self._poison_zero, None
+            if _inj.should_fire("serve.decode.nan", context=f"slot {active_idx[0]}"):
+                poisoned = active_idx[0]
+                pz = np.zeros(self.slots, bool)
+                pz[poisoned] = True
+                poison_t = to_tensor(pz)
+            toks_t = to_tensor(toks)
+            vl_t = to_tensor(vl)
+        with self._watchdog.arm(
+            "serve.decode", timeout=self._wd_timeout(),
+            context=f"{len(active_idx)} active slots (spec k={self.spec_k})",
+        ):
+            out, n_emit, new_pos, finite, key = self._verify_fn(
+                toks_t, pos_t, active_t, vl_t, temps_t, poison_t, key,
+                self._tables_t,
+            )
+        with self._mu:
+            self._check_gen(gen)
+            self._key = key
+            self._dev = (new_pos, active_t, temps_t)
+            with self._watchdog.arm(
+                "serve.fetch", timeout=self._wd_timeout(),
+                context=f"verify fetch ({len(active_idx)} slots)",
+            ), _san.allowed_sync("speculative verify fetch"):
+                out_np = np.asarray(out.numpy())
+                n_np = np.asarray(n_emit.numpy()).reshape(-1)
+                fin_np = np.asarray(finite.numpy()).reshape(-1)
+            # a restart that could not take the mutex may have superseded
+            # us mid-fetch — bail before touching the new life's slot table
+            self._check_gen(gen)
+            now = time.perf_counter()
+            per = now - t0
+            self._step_ewma_s = (
+                per if self._step_ewma_s is None
+                else 0.8 * self._step_ewma_s + 0.2 * per
+            )
+            accepted = 0
+            emitted_total = 0
+            for s in active_idx:
+                req = self._slot_req[s]
+                if req is None:
+                    continue
+                if not fin_np[s]:
+                    _prof.record_serving_fault("nonfinite")
+                    req.error = NonFiniteLogits(
+                        f"request {req.id}: non-finite logit window at "
+                        f"position {int(self._pos[s])} (slot {s}); the slot "
+                        "was evicted — co-batched requests are unaffected"
+                    )
+                    self._finish(s, req, "error")
+                    continue
+                n = int(n_np[s])
+                self._pos[s] += n
+                accepted += max(0, n - 1)
+                emitted_total += n
+                dr = self._drafters[s]
+                for j in range(n):
+                    if self._slot_req[s] is not req:
+                        break  # EOS inside the accepted window right-trims
+                    tok = int(out_np[s, j])
+                    self._last_tok[s] = tok
+                    if dr is not None:
+                        dr.extend(tok)
+                    self._emit(s, req, tok)
+            if emitted_total:
+                self._tok_rate_ewma = (
+                    0.8 * self._tok_rate_ewma
+                    + 0.2 * (emitted_total / len(active_idx))
+                )
+            if self._ep is not None:
+                self._ep["ticks"] += 1
+                self._ep["accepted"] += accepted
+            _prof.record_serving_tick(
+                len(active_idx) / self.slots, self._queue.qsize(),
+                time.perf_counter() - t0,
+            )
+            _prof.record_paging_tick(
+                self._pool.used_count(), self._pool.usable_pages
+            )
+            _prof.record_speculation(
+                proposed, accepted, emitted_total, len(active_idx)
+            )
+        return len(active_idx)
+
     def _obs_epoch_open(self, active_idx):
         """Start a decode-epoch summary (caller holds _mu): the stretch of
         constant slot membership that begins at this device-state rebuild.
@@ -1577,11 +1869,18 @@ class ContinuousBatchingEngine:
         if not any(r.trace for _, r in members):
             self._ep = None
             return
-        self._ep = {"t0": time.perf_counter(), "ticks": 0, "members": members}
+        self._ep = {
+            "t0": time.perf_counter(), "ticks": 0, "members": members,
+            # speculation accounting over the epoch (zeros in plain mode)
+            "proposed": 0, "accepted": 0,
+        }
 
     def _obs_epoch_close(self):
         """Close the open decode epoch (caller holds _mu): one summarizing
-        engine.decode span per traced member request."""
+        engine.decode span per traced member request — plus, when
+        speculation is on, an engine.verify span carrying the epoch's
+        proposed/accepted draft counts (the trace-visible acceptance
+        evidence ISSUE 11 requires)."""
         ep, self._ep = self._ep, None
         if not ep or not ep["ticks"]:
             return
@@ -1593,6 +1892,13 @@ class ContinuousBatchingEngine:
                     parent_id=req.trace[1], req=req.id, slot=s,
                     ticks=ep["ticks"],
                 )
+                if self._spec_on:
+                    _obs.record(
+                        "engine.verify", req.trace[0], t0=ep["t0"], t1=t1,
+                        parent_id=req.trace[1], req=req.id, slot=s,
+                        ticks=ep["ticks"], proposed=ep["proposed"],
+                        accepted=ep["accepted"],
+                    )
 
     def _flush_pending_locked(self):
         """Fetch every dispatched-but-unfetched decode step and emit its
@@ -1679,6 +1985,7 @@ class ContinuousBatchingEngine:
         self._pos[s] = 0
         self._last_tok[s] = 0
         self._temps[s] = 0.0
+        self._drafters[s] = None
         if self.paged:
             # mappings drop; committed prefix pages live on through the
             # cache's own hold, everything else returns to the free list
@@ -1792,6 +2099,22 @@ class ContinuousBatchingEngine:
                     f"writes page entry {frontier} but maps only "
                     f"{len(mapped)} pages"
                 )
+            if self._spec_on:
+                # the next verify window may legally overrun the mapping
+                # (rejected-draft territory), but every overrun entry must
+                # scatter to scratch — a nonzero table value there would
+                # aim garbage at a live page
+                _win_in, win_over = spec_write_pages(
+                    int(self._pos[s]), self.spec_k + 1, ps, len(mapped)
+                )
+                for e in win_over:
+                    if e < row.shape[0] and row[e] != 0:
+                        raise AssertionError(
+                            f"page invariant: slot {s} verify window entry "
+                            f"{e} is past its {len(mapped)}-page mapping but "
+                            f"table row holds page {int(row[e])} (expected "
+                            "0 = scratch redirect)"
+                        )
             for p in mapped:
                 expected[p] += 1
         if self._prefix is not None:

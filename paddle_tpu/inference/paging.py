@@ -26,11 +26,39 @@ Sharing safety contract (relied on by the engine and the COW tests):
 - a reader never writes a shared page: full-page matches are read-only by
   construction (its own rows start after them) and the tail match is copied
   into a fresh page at admission, before any token lands.
+
+Speculative verify (ISSUE 11) widens the decode write from one row to a
+`[pos, pos+k]` window per slot.  The same scatter contract covers it: every
+window row whose page-table entry is unmapped (table value 0) or beyond the
+table redirects to scratch page 0, so REJECTED draft positions need no
+rollback — their KV rows either landed in scratch or sit past the slot's
+advanced `pos`, where the next verify window overwrites them before any
+query can attend them (attention masks j <= pos+i).  `spec_write_pages`
+below is the host-side mirror of that arithmetic, used by the engine's
+debug-invariants check.
 """
 
 from __future__ import annotations
 
 import numpy as np
+
+
+def spec_write_pages(pos, width, page_size, mapped_entries):
+    """Page-table entries a verify window `[pos, pos+width)` writes through.
+
+    Returns `(in_table, overrun)`: sorted entry indices that fall inside the
+    slot's mapped table prefix (`entry < mapped_entries`) and those beyond it.
+    Overrun entries MUST scatter to scratch page 0 on device — the engine's
+    draft-budget clamp (`min(k, remaining-1)`) keeps every COMMITTED row in
+    the mapped prefix, so a non-empty overrun set is only ever rejected-draft
+    territory.  Pure host arithmetic; no device state."""
+    pos, width, ps = int(pos), int(width), int(page_size)
+    if width <= 0:
+        return [], []
+    entries = sorted({(pos + i) // ps for i in range(width)})
+    in_table = [e for e in entries if e < mapped_entries]
+    overrun = [e for e in entries if e >= mapped_entries]
+    return in_table, overrun
 
 
 class PagePool:

@@ -1,0 +1,97 @@
+"""Prompt-lookup drafting for speculative decoding (ISSUE 11).
+
+The paged engine's verify step (engine.py `_verify_paged_body`) checks k
+drafted tokens plus the committed last token in ONE compiled forward; this
+module is the host half that produces the drafts.  There is no second
+model: the drafter is pure n-gram lookup over the slot's OWN history
+(prompt + everything generated so far), the classic prompt-lookup trick —
+exactly the shared-system-prompt / template-heavy traffic the prefix cache
+already optimizes for is the traffic whose continuations repeat.
+
+Greedy equivalence does not depend on draft quality: the verify step
+accepts draft i only while it equals the target model's own greedy
+continuation, so a bad draft costs wasted FLOPs (positions the step would
+otherwise leave idle — decode is HBM-bound, they are nearly free), never a
+wrong token.  The drafter therefore optimizes hit rate only.
+
+Everything here is host-side Python state, one instance per engine slot,
+mutated only by the scheduler thread that owns the slot (under the
+engine's `_mu`, like the rest of the slot table).  Nothing is traced:
+draft CONTENT rides the compiled verify step as data (`toks[slots, k+1]`,
+`valid_len[slots]`), so acceptance-rate churn never changes a shape.
+"""
+
+from __future__ import annotations
+
+
+class NgramDrafter:
+    """Per-slot prompt-lookup drafter.
+
+    Indexes every n-gram (n = max_ngram .. 1) of the history as it grows;
+    `propose(k)` matches the history's current n-token suffix against the
+    latest earlier occurrence and returns the tokens that followed it —
+    the continuation bet — longest order first, at most k tokens, possibly
+    none.  A history shorter than max_ngram simply backs off to the orders
+    that fit (a one-token prompt can still draft from 1-gram matches).
+
+    The index keeps the latest TWO occurrence positions per n-gram: the
+    most recent occurrence of the current suffix is always the suffix
+    itself (empty continuation), so lookup falls back to the previous one.
+    """
+
+    def __init__(self, max_ngram=3):
+        self.max_ngram = max(1, int(max_ngram))
+        self.tokens = []
+        # order -> {ngram tuple -> (previous_start, latest_start)} where a
+        # "start" is the index of the token FOLLOWING that occurrence
+        self._index = {n: {} for n in range(1, self.max_ngram + 1)}
+
+    def __len__(self):
+        return len(self.tokens)
+
+    def reset(self, history):
+        """Rebuild from scratch (prefill landing, warm restart re-admission):
+        `history` is the prompt plus any already-emitted tokens."""
+        self.tokens = []
+        self._index = {n: {} for n in range(1, self.max_ngram + 1)}
+        for t in history:
+            self.extend(t)
+        return self
+
+    def extend(self, tok):
+        """Append one committed token and index the n-grams it completes.
+        O(max_ngram) per token — negligible next to a decode dispatch."""
+        self.tokens.append(int(tok))
+        end = len(self.tokens)
+        for n in range(1, self.max_ngram + 1):
+            if end < n:
+                break
+            d = self._index[n]
+            key = tuple(self.tokens[end - n:end])
+            prev = d.get(key)
+            d[key] = (prev[1] if prev is not None else None, end)
+
+    def propose(self, k):
+        """Up to `k` draft tokens continuing the current history, longest
+        matching n-gram first; [] when no earlier occurrence exists."""
+        k = int(k)
+        if k <= 0 or not self.tokens:
+            return []
+        L = len(self.tokens)
+        for n in range(min(self.max_ngram, L), 0, -1):
+            slot = self._index[n].get(tuple(self.tokens[L - n:]))
+            if slot is None:
+                continue
+            for j in slot[::-1]:  # latest occurrence first, then previous
+                if j is not None and j < L:
+                    if j + k <= L:
+                        return self.tokens[j:j + k]
+                    # The match sits p = L - j tokens from the end: the
+                    # continuation bet IS "the stream is periodic with
+                    # period p", so extrapolate the cycle to the full k
+                    # instead of truncating the draft.  Period 1 (constant
+                    # runs, the greedy attractor of temperature-0 decode)
+                    # would otherwise cap every window at 1 draft.
+                    p = L - j
+                    return [self.tokens[j + (i % p)] for i in range(k)]
+        return []

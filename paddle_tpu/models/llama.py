@@ -245,7 +245,13 @@ class PagedDecodeView:
     [slots, max_pages_per_seq] int32 page table (data), each slot writes
     its token at page `tables[s, pos//page_size]` row `pos % page_size`
     and attends the gathered pages sliced back to [slots, max_len] — the
-    same attended geometry as the dense slot pool, bit for bit."""
+    same attended geometry as the dense slot pool, bit for bit.
+
+    Multi-query verify (speculative decoding): the same view serves a
+    [slots, k+1] token window — row i writes page entry (pos+i)//page_size
+    (overruns redirected to scratch, see `_page_decode_write`) and attends
+    positions j <= pos+i through the per-row-pos decode kernel.  Row 0 of
+    a k+1 window is therefore the exact single-token decode step."""
 
     def __init__(self, arena, tables, max_len):
         self.arena = arena
@@ -280,9 +286,21 @@ def _page_scatter(arena_t, new_t, table_t, true_len_t, start_t=None):
 
 
 def _page_decode_write(arena_t, new_t, tables_t, pos_t):
-    """Per-slot decode write: slot s's [1, kv_heads, d] token K/V lands at
-    page tables[s, pos[s]//page_size] row pos[s] % page_size.  Inactive
-    slots run at pos 0 over an all-zero table row — scratch page 0."""
+    """Per-slot decode write: slot s's [s_q, kv_heads, d] token K/V rows land
+    at page tables[s, (pos[s]+i)//page_size] row (pos[s]+i) % page_size for
+    i < s_q.  Inactive slots run at pos 0 over an all-zero table row —
+    scratch page 0.
+
+    s_q == 1 is the plain decode step (kept on its own branch so the traced
+    scatter is byte-identical to the pre-speculation executable); s_q > 1 is
+    the speculative VERIFY step writing the whole draft window at once.
+    Rows whose page entry overruns the table — drafts past a slot's mapped
+    coverage, or the window tail of a slot about to hit its length bound —
+    are redirected to scratch page 0, the same rollback-by-redirect contract
+    `_page_scatter` gives prefill padding: a rejected draft's K/V is either
+    overwritten before any reader can attend it (positions >= the advanced
+    pos are rewritten by the next step's own window, writes precede
+    attention within every layer) or never lands in a mapped page at all."""
     import jax.numpy as jnp
 
     from ..ops.dispatch import apply
@@ -290,9 +308,20 @@ def _page_decode_write(arena_t, new_t, tables_t, pos_t):
     ps = arena_t.shape[1]
 
     def f(c, n, t, p):
-        entry = p // ps  # [slots]; pos < pages*ps by the admission math
-        pg = jnp.take_along_axis(t, entry[:, None], axis=1)[:, 0]
-        return c.at[pg, p % ps].set(n[:, 0].astype(c.dtype))
+        if n.shape[1] == 1:
+            entry = p // ps  # [slots]; pos < pages*ps by the admission math
+            pg = jnp.take_along_axis(t, entry[:, None], axis=1)[:, 0]
+            return c.at[pg, p % ps].set(n[:, 0].astype(c.dtype))
+        sq = n.shape[1]
+        idx = p[:, None] + jnp.arange(sq, dtype=p.dtype)[None, :]  # [slots, sq]
+        entry = idx // ps
+        P = t.shape[1]
+        pg = jnp.where(
+            entry < P,
+            jnp.take_along_axis(t, jnp.minimum(entry, P - 1), axis=1),
+            0,
+        )
+        return c.at[pg, idx % ps].set(n.astype(c.dtype))
 
     return apply(f, [arena_t, new_t, tables_t, pos_t], name="kv_page_decode_write")
 

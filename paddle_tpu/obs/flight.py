@@ -164,6 +164,15 @@ def dump(reason, path=None):
             "events": len(ring),
             "armed": armed,
         }
+        try:
+            # speculation state at death (acceptance rate collapse is a
+            # classic "why did serving slow down" post-mortem question)
+            from .. import profiler as _prof
+            spec = _prof.speculation_summary()
+            if spec:
+                header["speculation"] = spec
+        except Exception:
+            pass
         with open(path, "w") as f:
             f.write(json.dumps(header) + "\n")
             for ev in ring:

@@ -27,6 +27,9 @@ Metric-name reference (the stable surface the scrape test pins):
     paddle_paging_cow_copies_total
     paddle_paging_cache_evictions_total / _commits_total
     paddle_paging_pages_used_peak / paddle_paging_pages_total
+    paddle_spec_steps_total / paddle_spec_proposed_tokens_total
+    paddle_spec_accepted_tokens_total / paddle_spec_emitted_tokens_total
+    paddle_spec_acceptance_rate / paddle_spec_tokens_per_step
     paddle_router_requests_total, _retries_total, _failovers_total,
     paddle_router_breaker_trips_total / _half_open_total / _closes_total
     paddle_router_hedges_total / _hedge_wins_total
@@ -168,6 +171,23 @@ def render(labels=None):
             "peak pages in use in the paged-KV pool", "gauge")
     exp.add("paddle_paging_pages_total", g["pages_total"],
             "total pages in the paged-KV pool", "gauge")
+
+    g = snap["speculation"]
+    exp.add("paddle_spec_steps_total", g["steps"],
+            "speculative verify steps dispatched")
+    exp.add("paddle_spec_proposed_tokens_total", g["proposed"],
+            "draft tokens proposed by the prompt-lookup drafter")
+    exp.add("paddle_spec_accepted_tokens_total", g["accepted"],
+            "draft tokens accepted by the batched verify step")
+    exp.add("paddle_spec_emitted_tokens_total", g["emitted"],
+            "tokens emitted by verify steps (accepted + 1 per slot-step)")
+    exp.add("paddle_spec_acceptance_rate",
+            (g["accepted"] / g["proposed"]) if g["proposed"] else 0.0,
+            "accepted / proposed draft tokens", "gauge")
+    exp.add("paddle_spec_tokens_per_step",
+            (g["emitted"] / g["slot_steps"]) if g["slot_steps"] else 0.0,
+            "mean emitted tokens per slot-step (1.0 = no speculation win)",
+            "gauge")
 
     g = snap["router"]
     for key, name in (

@@ -660,6 +660,16 @@ def decode_attention_array(q, k, v, pos, scale=None):
     `pos`, never from a mask array.  Vector pos always takes the dense path
     (single-token decode is its domain and the dense matvec is the optimal
     lowering there anyway).
+
+    Per-row pos composes with sq > 1: this is the speculative-decoding
+    VERIFY contract (ISSUE 11).  A [b, k+1] draft window at per-slot
+    positions runs one dense pass where window row i of slot s attends
+    j <= pos[s] + i — row 0 reproduces the single-token decode step exactly
+    (same reduction geometry per row), and the extra k rows are the
+    near-free FLOPs speculation converts into accepted tokens.  Garbage
+    cache rows beyond a slot's true length sit at j > pos + i and carry
+    zero weight, so rejected-draft leftovers from a previous verify step
+    are never attended before the next window overwrites them.
     """
     b, sq, h, d = q.shape
     per_row_pos = jnp.ndim(pos) == 1

@@ -261,6 +261,7 @@ def reset():
         _reset_step_locked()
         _reset_serving_locked()
         _reset_paging_locked()
+        _reset_speculation_locked()
         _reset_router_locked()
         _flash_fallbacks.clear()
 
@@ -280,6 +281,7 @@ def metrics_snapshot():
             "step": dict(_step_gauges),
             "serving": serving,
             "paging": dict(_paging_gauges),
+            "speculation": dict(_spec_gauges),
             "router": router,
             "flash_fallbacks": dict(_flash_fallbacks),
         }
@@ -352,6 +354,66 @@ def paging_summary():
 
 
 # ---------------------------------------------------------------------------
+# Speculative-decoding gauges (ISSUE 11): the paged engine reports one record
+# per verify step — drafts proposed, drafts accepted, tokens emitted, and the
+# slot-steps the step covered — so acceptance rate and mean emitted tokens
+# per slot-step (the speculation multiplier) are answerable from the summary,
+# /metrics, and the flight-recorder header.
+# ---------------------------------------------------------------------------
+
+_spec_gauges = {
+    "steps": 0,       # verify dispatches
+    "proposed": 0,    # draft tokens offered to the verifier
+    "accepted": 0,    # draft tokens that matched the model's greedy path
+    "emitted": 0,     # tokens emitted (accepted drafts + 1 bonus per slot)
+    "slot_steps": 0,  # sum over steps of active slots (the 1x baseline)
+}
+
+
+def record_speculation(proposed, accepted, emitted, slots):
+    """One speculative verify step: drafts proposed/accepted across the
+    batch, tokens emitted, and how many active slots took part."""
+    with _counters_lock:
+        g = _spec_gauges
+        g["steps"] += 1
+        g["proposed"] += int(proposed)
+        g["accepted"] += int(accepted)
+        g["emitted"] += int(emitted)
+        g["slot_steps"] += int(slots)
+
+
+def _reset_speculation_locked():
+    for k in _spec_gauges:
+        _spec_gauges[k] = 0
+
+
+def reset_speculation():
+    with _counters_lock:
+        _reset_speculation_locked()
+
+
+def speculation_summary():
+    """Aggregated speculation metrics: acceptance rate over proposed drafts
+    and mean emitted tokens per slot-step (1.0 = no speedup; the plain
+    engine's ratio by construction).  Empty dict before any verify step."""
+    with _counters_lock:
+        g = dict(_spec_gauges)
+    if not g["steps"]:
+        return {}
+    out = {
+        "steps": g["steps"],
+        "proposed": g["proposed"],
+        "accepted": g["accepted"],
+        "emitted": g["emitted"],
+    }
+    if g["proposed"]:
+        out["acceptance_rate"] = g["accepted"] / g["proposed"]
+    if g["slot_steps"]:
+        out["tokens_per_step"] = g["emitted"] / g["slot_steps"]
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Router gauges (ISSUE 9): the multi-replica serving router counts every
 # routed request, retry/failover, breaker transition, hedge, and brownout
 # shed, plus a per-replica state snapshot — so "which replica is sick and
@@ -417,7 +479,9 @@ def _pctl(sorted_vals, q):
 
 def serving_summary():
     """Aggregated serving metrics: requests, tokens, aggregate tokens/s over
-    the busy window, TTFT p50/p95, mean slot occupancy, queue depth avg/max."""
+    the busy window, TTFT p50/p95, mean slot occupancy, queue depth avg/max —
+    plus a nested `speculation` block (acceptance rate, tokens/step) when
+    any verify step ran."""
     with _counters_lock:
         g = dict(_serving_gauges)
         g["ttfts_s"] = list(g["ttfts_s"])
@@ -436,6 +500,9 @@ def serving_summary():
         out["queue_depth_max"] = g["queue_depth_max"]
     if g["faults"]:
         out["faults"] = dict(g["faults"])
+    spec = speculation_summary()
+    if spec:
+        out["speculation"] = spec
     return out
 
 

@@ -39,6 +39,7 @@ _SANITIZED_MODULES = {
     "test_serving_fault",
     "test_async_pipeline",
     "test_observability",
+    "test_spec_decode",
 }
 
 

@@ -10,7 +10,8 @@
 #                  hard external timeout so a broken watchdog cannot wedge CI
 #   chaos-serve  — the SERVING fault-domain drills (prefill hang -> watchdog
 #                  -> warm restart, NaN isolation, SIGTERM drain, deadline
-#                  eviction), slow HTTP drill included, under a hard timeout
+#                  eviction), slow HTTP drill included, plus the speculative
+#                  and 4-tenant mixed-adapter reruns, under a hard timeout
 #   chaos-router — the MULTI-REPLICA router drills (ISSUE 9): 2 replicas,
 #                  injected probe flap + kill -9 under Poisson load, breaker
 #                  cycle, rolling drain — exactly-once resolution end to end
@@ -66,6 +67,17 @@ if [ "$MODE" = "chaos-serve" ]; then
       python -m pytest \
       "tests/test_serving_fault.py::test_prefill_hang_watchdog_restart_bit_identical" \
       "tests/test_serving_fault.py::test_decode_nan_poisons_only_target_slot" \
+      -q -p no:cacheprovider
+  echo "== mixed-adapter chaos drill (ISSUE 12) =="
+  # the kill -9 drill rerun with 4 LoRA tenants: both subprocess replicas
+  # boot --lora a1,a2,a3,a4 (position-seeded -> bit-identical adapter
+  # weights fleet-wide), Poisson load cycles the tenants, SIGKILL takes one
+  # replica mid-stream — exactly-once resolution, per-tenant outputs
+  # bit-identical to a single-process LoRA engine, survivor residency
+  # drives adapter-aware pick(), unknown tenant fails typed 404
+  timeout -k 30 600 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python -m pytest \
+      "tests/test_serving_router.py::test_kill9_chaos_drill_mixed_adapters" \
       -q -p no:cacheprovider
   echo "CHAOS-SERVE OK"
   exit 0
@@ -173,6 +185,20 @@ SPEC_TESTS=(tests/test_spec_decode.py::test_spec_greedy_token_identical_to_plain
 [ "$MODE" != "fast" ] && SPEC_TESTS=(tests/test_spec_decode.py)
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python -m pytest "${SPEC_TESTS[@]}" -q -p no:cacheprovider
+
+echo "== multi-tenant LoRA smoke (ISSUE 12 acceptance subset) =="
+# both tiers: a mixed-adapter co-batch decodes in the SAME compiled
+# executables with per-tenant outputs bit-identical to single-adapter
+# engines, and 16 tenants share one compiled decode step with zero
+# recompiles (adapter ids ride as traced data); fast mode runs that pair,
+# full mode the whole file (arena refcount/LRU, churn-without-recompiles,
+# warm restart residency, per-adapter prefix-cache isolation, spec-decode
+# composition, HTTP adapter field + 404, adapter-aware router pick)
+LORA_TESTS=(tests/test_lora_serving.py::test_mixed_cobatch_bit_identity_zero_recompiles
+            tests/test_lora_serving.py::test_sixteen_adapters_cobatch_one_decode)
+[ "$MODE" != "fast" ] && LORA_TESTS=(tests/test_lora_serving.py)
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python -m pytest "${LORA_TESTS[@]}" -q -m "not slow" -p no:cacheprovider
 
 echo "== serving fault drills (ISSUE 6 acceptance subset) =="
 # both tiers run the deterministic core of the serving fault domain: the

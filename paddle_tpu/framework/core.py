@@ -509,6 +509,21 @@ define_flag(
     "a miss — a prompt shorter than n just drafts from lower orders)",
 )
 define_flag(
+    "FLAGS_serve_lora_capacity", 8,
+    "multi-tenant LoRA serving: resident-adapter slots in the paged adapter "
+    "arena (slot 0 is the pinned base-model passthrough on top of this).  "
+    "Residency is refcounted + LRU-evicted exactly like KV pages; a request "
+    "naming a non-resident adapter loads it at admission (or parks under "
+    "pressure).  Per-slot adapter ids are traced data, so any mix of "
+    "resident adapters co-batches in the same compiled decode step",
+)
+define_flag(
+    "FLAGS_serve_lora_rank_max", 8,
+    "multi-tenant LoRA serving: the arena's stacked A/B factors are padded "
+    "to this rank; registering an adapter with a higher rank than the "
+    "engine's arena fails at submit.  Padding columns are zero — exact",
+)
+define_flag(
     "FLAGS_router_probe_interval", 0.25,
     "serving router: seconds between /healthz probes of each registered "
     "replica (drives live/ready/draining/dead tracking and load gauges)",

@@ -177,9 +177,12 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
     - POST /generate          -> {"tokens": [...]}    (GenerationPredictor or
       ContinuousBatchingEngine; body: {"input_ids": [...] or [[...], ...],
       "max_new_tokens": n, "temperature": t, "eos_token_id": id,
-      "deadline_s": s, "spec_k": k}).  "spec_k" caps the request's
-      speculative draft length below the engine-wide FLAGS_serve_spec_k
-      (0 opts out of speculation; omitted = engine default)
+      "deadline_s": s, "spec_k": k, "adapter": name}).  "spec_k" caps the
+      request's speculative draft length below the engine-wide
+      FLAGS_serve_spec_k (0 opts out of speculation; omitted = engine
+      default).  "adapter" names a registered LoRA adapter served from the
+      engine's adapter arena (omitted = base model); an unregistered name
+      is a typed 404 (`AdapterUnknown`, retriable: false)
 
     A ContinuousBatchingEngine serves /generate with true continuous
     batching: concurrent requests decode interleaved in the slot pool, each
@@ -204,6 +207,7 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
 
     from . import engine as engine_mod
     from .engine import ContinuousBatchingEngine, EngineUnavailable
+    from ..lora.registry import AdapterUnknown
     from ..fault import EngineSupervisor
     from ..framework import core as _fcore
     from ..obs import flight as _flight
@@ -352,8 +356,14 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
                                     None if req.get("spec_k") is None
                                     else int(req["spec_k"])
                                 ),
+                                adapter=req.get("adapter"),
                             )
                         )
+                except AdapterUnknown as e:
+                    # terminal 404: retrying cannot help until someone
+                    # registers the adapter — the router must NOT fail over
+                    self._reply_error(404, type(e).__name__, str(e), False)
+                    return
                 except engine_mod.DeadlineUnattainable as e:
                     # 504 but retriable: a LESS LOADED replica may still
                     # meet the deadline — the router fails over on this

@@ -202,7 +202,8 @@ class EngineRequest:
     {eos, length, timeout, cancelled, restarted, error} — exactly once."""
 
     def __init__(self, rid, prompt, max_new_tokens, temperature, eos_token_id,
-                 on_token, deadline_s=None, trace=None, spec_k=None):
+                 on_token, deadline_s=None, trace=None, spec_k=None,
+                 adapter=None):
         self.id = int(rid)
         # (trace_id, parent_span_id) from the submitting hop, or None;
         # every engine-stage span for this request parents under it
@@ -214,6 +215,10 @@ class EngineRequest:
         # per-request speculation cap: None = engine default, 0 = opt out,
         # >0 clamps below the engine-wide FLAGS_serve_spec_k
         self.spec_k = None if spec_k is None else int(spec_k)
+        # resolved LoRAAdapter (None = base model); adapter_slot is the
+        # arena row this request's binding ref pins, set at admission
+        self.adapter = adapter
+        self.adapter_slot = None
         self.on_token = on_token
         self.deadline_s = None if deadline_s is None else float(deadline_s)
         self.tokens = []  # generated ids (includes eos when hit)
@@ -275,7 +280,7 @@ class ContinuousBatchingEngine:
 
     def __init__(self, model, slots=None, max_len=None, prefill_buckets=None,
                  queue_depth=None, seed=0, paged=None, page_size=None,
-                 pool_pages=None, prefix_cache=None, spec_k=None):
+                 pool_pages=None, prefix_cache=None, spec_k=None, lora=None):
         import jax
 
         from .. import jit, to_tensor
@@ -360,6 +365,16 @@ class ContinuousBatchingEngine:
             ]
             self._decode_fn = jit.to_static(self._decode_body)
             self._prefill_fn = jit.to_static(self._prefill_body)
+        # multi-tenant LoRA (ISSUE 12): an AdapterArena whose per-slot ids
+        # ride the paged executables as DATA — co-batched slots on different
+        # adapters share one compiled step, id 0 is the base passthrough
+        if lora is not None and not self.paged:
+            raise ValueError("LoRA serving requires the paged engine")
+        self._lora = lora
+        # arena slot bound per ENGINE slot (0 = base model); mirrors
+        # _page_table's lifecycle: set at slot landing, cleared at recycle
+        self._slot_adapter = np.zeros(self.slots, np.int32)
+        self._adapters_t = None  # device mirror, rebuilt with _dev
         # speculative decoding (paged engines only — it rides the page
         # scatter's scratch redirect for rejected-row safety)
         sk = int(_fcore.flag("FLAGS_serve_spec_k") if spec_k is None else spec_k)
@@ -496,13 +511,18 @@ class ContinuousBatchingEngine:
         nxt, key = apply(f, [logits, key, temp], multi=True, name="serve_sample1")
         return nxt, key
 
-    def _decode_paged_body(self, toks, pos, active, temps, poison, key, tables):
+    def _decode_paged_body(self, toks, pos, active, temps, poison, key, tables,
+                           adapters):
         """_decode_body over the paged arena: identical math, but each slot's
         K/V rows are gathered through its page-table row (`tables`
         [slots, max_pages_per_seq] int32 — DATA, so remaps never retrace).
-        Bit-identical tokens to the dense decode given identical cache rows:
-        the gather reproduces the dense [slots, max_len] geometry exactly and
-        rows beyond `pos` are masked to zero weight either way."""
+        `adapters` [slots] int32 (data too) names each slot's LoRA arena row;
+        with an arena attached every projection adds the gathered low-rank
+        delta, and row 0 (all-zero factors) keeps base-model slots
+        bit-identical.  Bit-identical tokens to the dense decode given
+        identical cache rows: the gather reproduces the dense
+        [slots, max_len] geometry exactly and rows beyond `pos` are masked
+        to zero weight either way."""
         import jax
         import jax.numpy as jnp
 
@@ -512,7 +532,8 @@ class ContinuousBatchingEngine:
             lambda p, a: jnp.where(a, p, 0), [pos, active], name="serve_pos_mask"
         )
         views = [PagedDecodeView(a, tables, self.max_len) for a in self._arenas]
-        hidden, _ = self.model.llama(toks, caches=views, pos=pos_eff)
+        lora = self._lora.view(adapters) if self._lora is not None else None
+        hidden, _ = self.model.llama(toks, caches=views, pos=pos_eff, lora=lora)
         logits = self.model.lm_head(hidden)[:, -1]  # [S, V]
 
         def f(lg, ky, tp, p, a, po):
@@ -534,7 +555,7 @@ class ContinuousBatchingEngine:
         return nxt, new_pos, finite, key
 
     def _verify_paged_body(self, toks, pos, active, valid_len, temps, poison,
-                           key, tables):
+                           key, tables, adapters):
         """Speculative verify: ONE compiled forward scores k+1 positions per
         slot.  toks [S, k+1] — column 0 the committed last token (not yet in
         KV; this window writes it), columns 1..k the host-side prompt-lookup
@@ -550,8 +571,12 @@ class ContinuousBatchingEngine:
         (or on scratch via the table redirect) and the next window rewrites
         [new_pos, new_pos+k] before anything attends it.  Sampled slots
         (temp > 0) ride at valid_len 1; column 0 samples on the SAME
-        one-split-per-step key schedule as `_decode_paged_body`.  Returns
-        (out [S,k+1], n_emit [S], new_pos [S], finite [S], key)."""
+        one-split-per-step key schedule as `_decode_paged_body`.  The verify
+        window gathers the same per-slot `adapters` ids as plain decode, so
+        speculation composes with multi-tenant LoRA: greedy equivalence is
+        per-adapter (draft i accepted only while it matches THAT adapter's
+        greedy continuation).  Returns (out [S,k+1], n_emit [S],
+        new_pos [S], finite [S], key)."""
         import jax
         import jax.numpy as jnp
 
@@ -561,7 +586,8 @@ class ContinuousBatchingEngine:
             lambda p, a: jnp.where(a, p, 0), [pos, active], name="serve_pos_mask"
         )
         views = [PagedDecodeView(a, tables, self.max_len) for a in self._arenas]
-        hidden, _ = self.model.llama(toks, caches=views, pos=pos_eff)
+        lora = self._lora.view(adapters) if self._lora is not None else None
+        hidden, _ = self.model.llama(toks, caches=views, pos=pos_eff, lora=lora)
         logits = self.model.lm_head(hidden)  # [S, k+1, V]
 
         def f(lg, tk, ky, tp, p, a, vl, po):
@@ -596,11 +622,14 @@ class ContinuousBatchingEngine:
         )
         return out, n_emit, new_pos, finite, key
 
-    def _prefill_paged_body(self, toks, row_table, true_len, temp, key):
+    def _prefill_paged_body(self, toks, row_table, true_len, temp, key,
+                            adapters):
         """_prefill_body for a fresh paged prefill: the prompt attends to
         itself causally (the exact dense-SlotView math — bit-identical first
         tokens) while its K/V scatter into the pages of `row_table`
-        ([max_pages_per_seq] int32, data).  Padding rows land on scratch."""
+        ([max_pages_per_seq] int32, data).  `adapters` ([1] int32, data) is
+        the request's LoRA arena row (0 = base).  Padding rows land on
+        scratch."""
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -611,7 +640,8 @@ class ContinuousBatchingEngine:
             PagedPrefillView(a, row_table, true_len, self.max_len)
             for a in self._arenas
         ]
-        hidden, _ = self.model.llama(toks, caches=views)
+        lora = self._lora.view(adapters) if self._lora is not None else None
+        hidden, _ = self.model.llama(toks, caches=views, lora=lora)
         h_last = apply(
             lambda h, n: lax.dynamic_slice_in_dim(h, n - 1, 1, 1),
             [hidden, true_len], name="serve_prefill_last",
@@ -630,13 +660,18 @@ class ContinuousBatchingEngine:
         nxt, key = apply(f, [logits, key, temp], multi=True, name="serve_sample1")
         return nxt, key
 
-    def _chunk_prefill_body(self, toks, row_table, true_len, start, temp, key):
+    def _chunk_prefill_body(self, toks, row_table, true_len, start, temp, key,
+                            adapters):
         """Prefix-cache-hit prefill: only the UNSHARED suffix runs through
         the model.  toks [1, bucket] holds the suffix (right-padded),
         true_len its real length, start (int32[1], data) the absolute
         position of suffix row 0 — suffix row i writes page
         table[(start+i)//ps] and attends positions j <= start+i through the
-        table gather, shared prefix pages included."""
+        table gather, shared prefix pages included.  `adapters` ([1] int32,
+        data) is the request's LoRA arena row — safe to combine with prefix
+        sharing because cache entries are keyed by (adapter, token chain):
+        a hit guarantees the shared pages were prefilled under the SAME
+        adapter."""
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -647,7 +682,8 @@ class ContinuousBatchingEngine:
             PagedPrefillView(a, row_table, true_len, self.max_len, start=start)
             for a in self._arenas
         ]
-        hidden, _ = self.model.llama(toks, caches=views)
+        lora = self._lora.view(adapters) if self._lora is not None else None
+        hidden, _ = self.model.llama(toks, caches=views, lora=lora)
         h_last = apply(
             lambda h, n: lax.dynamic_slice_in_dim(h, n - 1, 1, 1),
             [hidden, true_len], name="serve_prefill_last",
@@ -686,14 +722,18 @@ class ContinuousBatchingEngine:
 
     def submit(self, input_ids, max_new_tokens=32, temperature=0.0,
                eos_token_id=None, on_token=None, deadline_s=None,
-               trace=None, spec_k=None):
+               trace=None, spec_k=None, adapter=None):
         """Enqueue one request (1-D token ids).  Returns an EngineRequest
         handle immediately; raises QueueFull when the admission queue is at
         capacity, DeadlineUnattainable when `deadline_s` cannot beat the
         current queue-drain estimate (deadline-aware admission), and
         EngineUnavailable while draining or after the restart budget is
         spent.  `spec_k` caps this request's speculative draft length below
-        the engine-wide FLAGS_serve_spec_k (0 opts out, None = default)."""
+        the engine-wide FLAGS_serve_spec_k (0 opts out, None = default).
+        `adapter` names a registered LoRA adapter (name or stable id; None
+        or 0 = base model) — AdapterUnknown propagates for unregistered
+        names, so clients see the typed 404 before the request ever
+        queues."""
         from .. import profiler as _prof
 
         ids = np.asarray(input_ids, np.int32).reshape(-1)
@@ -709,6 +749,21 @@ class ContinuousBatchingEngine:
             raise ValueError("deadline_s must be > 0")
         if spec_k is not None and int(spec_k) < 0:
             raise ValueError("spec_k must be >= 0")
+        adapter_obj = None
+        if adapter is not None and adapter != 0:
+            if self._lora is None:
+                raise ValueError(
+                    "engine has no LoRA arena (construct with lora=) but "
+                    f"request named adapter {adapter!r}"
+                )
+            # resolve NOW: an unknown name is terminal (AdapterUnknown ->
+            # HTTP 404), a known one is validated against the arena rank cap
+            adapter_obj = self._lora.registry.resolve(adapter)
+            if adapter_obj.rank > self._lora.rank_max:
+                raise ValueError(
+                    f"adapter {adapter_obj.name!r} rank {adapter_obj.rank} "
+                    f"exceeds the arena rank_max {self._lora.rank_max}"
+                )
         if self._dead:
             raise EngineUnavailable(
                 "engine is dead (restart budget exhausted); restart the server"
@@ -747,7 +802,7 @@ class ContinuousBatchingEngine:
         req = EngineRequest(
             next(self._req_ids), ids, max_new_tokens, temperature,
             eos_token_id, on_token, deadline_s=deadline_s, trace=trace,
-            spec_k=spec_k,
+            spec_k=spec_k, adapter=adapter_obj,
         )
         req._submit_t = time.perf_counter()
         if deadline_s is not None:
@@ -770,11 +825,12 @@ class ContinuousBatchingEngine:
     _req_ids = itertools.count(1)  # request ids unique across engines
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
-                 eos_token_id=None, timeout=None):
+                 eos_token_id=None, timeout=None, adapter=None):
         """Submit + wait.  Drives the scheduler inline when no background
         thread is running; returns prompt + generated ids (np.int32)."""
         req = self.submit(input_ids, max_new_tokens=max_new_tokens,
-                          temperature=temperature, eos_token_id=eos_token_id)
+                          temperature=temperature, eos_token_id=eos_token_id,
+                          adapter=adapter)
         if self._thread is None:
             self.run_until_idle()
         return req.wait(timeout)
@@ -788,20 +844,23 @@ class ContinuousBatchingEngine:
         from .. import to_tensor
 
         if self.paged:
-            # all-zero tables aim every warmup write at scratch page 0
+            # all-zero tables aim every warmup write at scratch page 0;
+            # all-zero adapter ids ride the base (zero-delta) arena row
             zero_row = to_tensor(np.zeros(self.pages_per_seq, np.int32))
+            zero_ad1 = to_tensor(np.zeros(1, np.int32))
+            zero_ads = to_tensor(np.zeros(self.slots, np.int32))
             for b in self.prefill_buckets:
                 # analysis: allow GRAFT010 — warmup runs before the scheduler thread exists; steady-state _key writes hold _mu
                 _, self._key = self._prefill_fn(
                     to_tensor(np.zeros((1, b), np.int32)), zero_row,
                     to_tensor(np.int32(b)), to_tensor(np.float32(0.0)),
-                    self._key,
+                    self._key, zero_ad1,
                 )
                 _, self._key = self._chunk_fn(
                     to_tensor(np.zeros((1, b), np.int32)), zero_row,
                     to_tensor(np.int32(b)),
                     to_tensor(np.zeros(1, np.int32)),
-                    to_tensor(np.float32(0.0)), self._key,
+                    to_tensor(np.float32(0.0)), self._key, zero_ad1,
                 )
             self._copy_fn(  # scratch onto itself: a no-op through the real fn
                 to_tensor(np.int32(0)), to_tensor(np.int32(0))
@@ -814,6 +873,7 @@ class ContinuousBatchingEngine:
                 self._poison_zero,
                 self._key,
                 to_tensor(np.zeros((self.slots, self.pages_per_seq), np.int32)),
+                zero_ads,
             )
             if self._spec_on:
                 # the one extra executable speculation buys: all-inactive
@@ -829,6 +889,7 @@ class ContinuousBatchingEngine:
                     to_tensor(
                         np.zeros((self.slots, self.pages_per_seq), np.int32)
                     ),
+                    zero_ads,
                 )
             with self._mu:
                 self._warm_buckets = set(self.prefill_buckets)
@@ -947,7 +1008,7 @@ class ContinuousBatchingEngine:
         else:
             page_free = (self.slots - self.active_slots) / self.slots
         ew = self._step_ewma_s
-        return {
+        out = {
             "status": status,
             "slots": self.slots,
             "active_slots": self.active_slots,
@@ -963,6 +1024,14 @@ class ContinuousBatchingEngine:
             # must be divided by when comparing replica throughput
             "tokens_per_step": round(self._tok_rate_ewma, 3),
         }
+        if self._lora is not None:
+            # adapter residency for the router: a replica already holding a
+            # request's adapter skips the load stall — least-loaded scoring
+            # prefers it
+            lora = dict(self._lora.stats())
+            lora["adapters"] = self._lora.resident()
+            out["lora"] = lora
+        return out
 
     # -- scheduler ----------------------------------------------------------
 
@@ -1115,6 +1184,17 @@ class ContinuousBatchingEngine:
                 for s in range(self.slots):
                     self._release_slot_pages_locked(s)
                 self._tables_t = None
+                if self._lora is not None:
+                    # warm restart keeps the ARENA too: binding refs drop
+                    # (re-queued requests re-acquire at re-admission) but
+                    # residency holds survive — resident adapters stay
+                    # uploaded, zero re-loads after the restart
+                    for req in requeue:
+                        self._release_adapter_locked(req)
+                    for req in fail:
+                        self._release_adapter_locked(req)
+                    self._slot_adapter[:] = 0
+                    self._adapters_t = None
             self._pos[:] = 0
             self._last_tok[:] = 0
             self._temps[:] = 0.0
@@ -1181,6 +1261,11 @@ class ContinuousBatchingEngine:
                 for s in range(self.slots):
                     self._release_slot_pages_locked(s)
                 self._tables_t = None
+                if self._lora is not None:
+                    for req in pending:
+                        self._release_adapter_locked(req)
+                    self._slot_adapter[:] = 0
+                    self._adapters_t = None
             self._pos[:] = 0
             self._last_tok[:] = 0
             self._temps[:] = 0.0
@@ -1316,6 +1401,23 @@ class ContinuousBatchingEngine:
         self._slot_pages[s] = []
         self._page_table[s, :] = 0
 
+    # -- LoRA adapter bindings ------------------------------------------------
+
+    @staticmethod
+    def _req_adapter_id(req):
+        """STABLE registry id for prefix-cache keying (0 = base).  Never the
+        arena slot — slots are recycled across adapters, ids are not."""
+        return 0 if req.adapter is None else req.adapter.adapter_id
+
+    def _release_adapter_locked(self, req):
+        """Drop the request's arena binding ref (residency survives — the
+        adapter stays warm for the next request).  Idempotent; caller holds
+        _mu."""
+        slot = req.adapter_slot
+        req.adapter_slot = None
+        if slot:
+            self._lora.release(slot)
+
     def _evict_expired(self, gen):
         """Evict cancelled/deadline-expired slots at step granularity: flush
         the tokens already dispatched, then recycle the slot (no recompile)
@@ -1396,7 +1498,9 @@ class ContinuousBatchingEngine:
                     need = self._pages_for(req.prompt.size, req.max_new_tokens)
                     exclude = ()
                     if self._prefix is not None:
-                        m, fulls, tail, _rows = self._prefix.lookup(req.prompt)
+                        m, fulls, tail, _rows = self._prefix.lookup(
+                            req.prompt, adapter=self._req_adapter_id(req)
+                        )
                         if m >= self.min_prefix_match:
                             need -= len(fulls)
                             exclude = set(fulls)
@@ -1410,6 +1514,20 @@ class ContinuousBatchingEngine:
                         self._requeue.insert(0, req)
                         self._queued_new_tokens += req.max_new_tokens
                         break
+                    if req.adapter is not None:
+                        # arena admission AFTER the page check, so a parked
+                        # request never sits in the queue holding a binding
+                        from ..lora.arena import AdapterArenaFull
+
+                        try:
+                            req.adapter_slot = self._lora.acquire(req.adapter)
+                        except AdapterArenaFull:
+                            # every arena slot is pinned by in-flight work:
+                            # park exactly like page pressure — a finishing
+                            # request's release unblocks us
+                            self._requeue.insert(0, req)
+                            self._queued_new_tokens += req.max_new_tokens
+                            break
                 self._admitting = req
                 req.state = "prefilling"
             try:
@@ -1426,8 +1544,10 @@ class ContinuousBatchingEngine:
                         if self.paged and gen == self._gen:
                             # the prefill died after mapping pages but before
                             # the slot landed — unmap them (a restart raced
-                            # ahead releases them itself)
+                            # ahead releases them itself) and drop the
+                            # adapter binding the admission took
                             self._release_slot_pages_locked(s)
+                            self._release_adapter_locked(req)
                         self._resolve(req, "error")
             finally:
                 with self._mu:
@@ -1512,7 +1632,9 @@ class ContinuousBatchingEngine:
             coverage = self._pages_for(L, req.max_new_tokens)
             match_len, shared_full, tail_page, tail_rows = 0, [], None, 0
             if self._prefix is not None:
-                m, fp, tp, tr = self._prefix.lookup(req.prompt)
+                m, fp, tp, tr = self._prefix.lookup(
+                    req.prompt, adapter=self._req_adapter_id(req)
+                )
                 if m >= self.min_prefix_match:
                     match_len, shared_full, tail_page, tail_rows = m, fp, tp, tr
                 else:
@@ -1579,12 +1701,16 @@ class ContinuousBatchingEngine:
                         to_tensor(np.int32(copy_args[0])),
                         to_tensor(np.int32(copy_args[1])),
                     )
+                ad_t = to_tensor(
+                    np.full(1, req.adapter_slot or 0, np.int32)
+                )
                 with self._bucket_growth(bucket):
                     if match_len == 0:
                         nxt, key = self._prefill_fn(
                             to_tensor(toks), to_tensor(row_table),
                             to_tensor(np.int32(L)),
                             to_tensor(np.float32(req.temperature)), key,
+                            ad_t,
                         )
                     else:
                         nxt, key = self._chunk_fn(
@@ -1592,6 +1718,7 @@ class ContinuousBatchingEngine:
                             to_tensor(np.int32(suffix)),
                             to_tensor(np.full(1, match_len, np.int32)),
                             to_tensor(np.float32(req.temperature)), key,
+                            ad_t,
                         )
                 with _san.allowed_sync("prefill first-token fetch"):
                     tok = int(np.asarray(nxt.numpy()).reshape(-1)[0])
@@ -1603,7 +1730,10 @@ class ContinuousBatchingEngine:
             self._check_gen(gen)  # a restart while we dispatched owns req now
             self._key = key
             if self._prefix is not None:
-                inserted = self._prefix.commit(req.prompt, pages, self._pool)
+                inserted = self._prefix.commit(
+                    req.prompt, pages, self._pool,
+                    adapter=self._req_adapter_id(req),
+                )
                 if inserted:
                     _prof.record_paging_event("cache_commits", inserted)
             req.ttft_s = time.perf_counter() - req._submit_t
@@ -1611,6 +1741,7 @@ class ContinuousBatchingEngine:
             self._pos[s] = L
             self._last_tok[s] = tok
             self._temps[s] = req.temperature
+            self._slot_adapter[s] = req.adapter_slot or 0
             if self._spec_on and req.temperature == 0.0 and (
                 req.spec_k is None or req.spec_k > 0
             ):
@@ -1632,6 +1763,7 @@ class ContinuousBatchingEngine:
                 req.trace[0], t0=t_pf, t1=time.perf_counter(),
                 parent_id=req.trace[1], req=req.id, bucket=bucket, slot=s,
                 prefix_match=match_len or None,
+                adapter=req.adapter.name if req.adapter is not None else None,
             )
 
     def _decode_once(self, gen):
@@ -1656,10 +1788,12 @@ class ContinuousBatchingEngine:
                     to_tensor(self._temps.copy()),
                 )
                 if self.paged:
-                    # page tables change exactly when membership does — the
-                    # same events that invalidate _dev — so one H2D mirror
-                    # per membership change covers every following step
+                    # page tables (and adapter bindings) change exactly when
+                    # membership does — the same events that invalidate _dev
+                    # — so one H2D mirror per membership change covers every
+                    # following step
                     self._tables_t = to_tensor(self._page_table.copy())
+                    self._adapters_t = to_tensor(self._slot_adapter.copy())
                 self._obs_epoch_open(active_idx)
             toks_t, pos_t, active_t, temps_t = self._dev
             key = self._key
@@ -1676,7 +1810,7 @@ class ContinuousBatchingEngine:
             if self.paged:
                 nxt, new_pos, finite, key = self._decode_fn(
                     toks_t, pos_t, active_t, temps_t, poison_t, key,
-                    self._tables_t,
+                    self._tables_t, self._adapters_t,
                 )
             else:
                 nxt, new_pos, finite, key = self._decode_fn(
@@ -1746,6 +1880,7 @@ class ContinuousBatchingEngine:
                     to_tensor(self._temps.copy()),
                 )
                 self._tables_t = to_tensor(self._page_table.copy())
+                self._adapters_t = to_tensor(self._slot_adapter.copy())
                 self._obs_epoch_open(active_idx)
             pos_t, active_t, temps_t = self._dev
             key = self._key
@@ -1787,7 +1922,7 @@ class ContinuousBatchingEngine:
         ):
             out, n_emit, new_pos, finite, key = self._verify_fn(
                 toks_t, pos_t, active_t, vl_t, temps_t, poison_t, key,
-                self._tables_t,
+                self._tables_t, self._adapters_t,
             )
         with self._mu:
             self._check_gen(gen)
@@ -1891,6 +2026,7 @@ class ContinuousBatchingEngine:
                     "engine.decode", req.trace[0], t0=ep["t0"], t1=t1,
                     parent_id=req.trace[1], req=req.id, slot=s,
                     ticks=ep["ticks"],
+                    adapter=req.adapter.name if req.adapter is not None else None,
                 )
                 if self._spec_on:
                     _obs.record(
@@ -1990,6 +2126,11 @@ class ContinuousBatchingEngine:
             # mappings drop; committed prefix pages live on through the
             # cache's own hold, everything else returns to the free list
             self._release_slot_pages_locked(s)
+            if self._lora is not None:
+                # the binding ref drops; residency survives, so the adapter
+                # stays warm until arena LRU pressure needs its slot
+                self._slot_adapter[s] = 0
+                self._release_adapter_locked(req)
         self._obs_epoch_close()
         self._dev = None  # membership changed: rebuild device loop state
         self._resolve(req, reason)
@@ -2061,6 +2202,20 @@ class ContinuousBatchingEngine:
                 )
             if self.paged:
                 self._check_page_invariants_locked()
+            if self._lora is not None:
+                bindings = {}
+                for s in range(self.slots):
+                    a = int(self._slot_adapter[s])
+                    if self._slot_req[s] is None:
+                        if a:
+                            raise AssertionError(
+                                f"lora invariant: free slot {s} still bound "
+                                f"to arena slot {a}"
+                            )
+                        continue
+                    if a:
+                        bindings[a] = bindings.get(a, 0) + 1
+                self._lora.check_invariants(bindings)
 
     def _check_page_invariants_locked(self):
         """FLAGS_serve_debug_invariants, paged extension: every page's

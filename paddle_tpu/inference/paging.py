@@ -133,9 +133,24 @@ class PrefixCache:
     partial last pages are stored as tails under their parent and matched by
     longest common prefix.  Eviction is LRU over childless entries only — a
     parent outlives its children, so no chain ever dangles.
+
+    Chains are rooted per ADAPTER (ISSUE 12): a prompt prefilled under LoRA
+    adapter A produced K/V that embed A's deltas, so a request under adapter
+    B (or the base model) must never COW-reuse those pages even for an
+    identical token chain.  `lookup`/`commit` take the request's STABLE
+    registry adapter id (0 = base) and walk from a per-adapter root — equal
+    prompts still share within an adapter, never across.
     """
 
     _ROOT = ()
+
+    def _root(self, adapter):
+        """Chain root for one adapter id.  The sentinel tuple can't collide
+        with a full-page key (whose first element is itself a key, never the
+        marker string) and is truthy, which `_remove`'s parent walk already
+        handles (no full entry is keyed by it, so the parent lookup misses
+        cleanly)."""
+        return self._ROOT if not adapter else ("__lora__", int(adapter))
 
     def __init__(self, page_size):
         self.page_size = int(page_size)
@@ -156,17 +171,18 @@ class PrefixCache:
         self._clock += 1
         entry.last_used = self._clock
 
-    def lookup(self, prompt):
-        """Longest cached prefix of `prompt` (np.int32 [L]), capped at L-1 so
-        at least one suffix token remains to prefill and sample from.
-        Returns (match_len, full_pages, tail_page, tail_rows): `full_pages`
-        are read-only mappable as-is, the tail page (if any) must be
-        copy-on-written before the reader appends.  Bumps LRU on the matched
-        chain; refcounts are the caller's job (it holds the pool)."""
+    def lookup(self, prompt, adapter=0):
+        """Longest cached prefix of `prompt` (np.int32 [L]) committed under
+        the same `adapter` id, capped at L-1 so at least one suffix token
+        remains to prefill and sample from.  Returns (match_len, full_pages,
+        tail_page, tail_rows): `full_pages` are read-only mappable as-is,
+        the tail page (if any) must be copy-on-written before the reader
+        appends.  Bumps LRU on the matched chain; refcounts are the
+        caller's job (it holds the pool)."""
         ps = self.page_size
         L = int(prompt.size)
         toks = prompt.tolist()
-        key = self._ROOT
+        key = self._root(adapter)
         full_pages = []
         matched = []
         i = 0
@@ -194,16 +210,17 @@ class PrefixCache:
             self._tick(e)
         return i + tail_rows, full_pages, tail_page, tail_rows
 
-    def commit(self, prompt, pages, pool):
+    def commit(self, prompt, pages, pool, adapter=0):
         """Insert-if-absent the prompt's pages after its prefill completed:
-        one full-page entry per complete page, one tail for the remainder.
-        New entries incref their page (the cache's own hold); pages whose
-        chain position is already cached are left alone — the committer may
-        have mapped that very entry's page at admission."""
+        one full-page entry per complete page, one tail for the remainder,
+        chained under the committing request's `adapter` root.  New entries
+        incref their page (the cache's own hold); pages whose chain position
+        is already cached are left alone — the committer may have mapped
+        that very entry's page at admission."""
         ps = self.page_size
         L = int(prompt.size)
         toks = prompt.tolist()
-        key = self._ROOT
+        key = self._root(adapter)
         inserted = 0
         for i in range(L // ps):
             ek = (key, tuple(toks[i * ps : (i + 1) * ps]))

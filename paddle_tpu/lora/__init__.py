@@ -1,0 +1,43 @@
+"""Multi-tenant LoRA serving (ISSUE 12, ROADMAP item 3).
+
+Serve N LoRA adapters from ONE base model on one engine.  Three pieces:
+
+- `AdapterRegistry` (registry.py) — validated low-rank A·B weight sets per
+  target matmul, each with a STABLE monotonically assigned integer id.  The
+  stable id (never the arena slot) keys everything identity-sensitive —
+  prefix-cache chains, healthz residency, span attrs — because arena slots
+  are recycled across evictions.
+- `AdapterArena` (arena.py) — device-resident stacked adapter weights
+  rationed exactly like KV pages: `inference/paging.PagePool` refcounts a
+  slot axis of `[capacity+1, ...]` A/B stacks, slot 0 is the pinned all-zero
+  base-model passthrough, eviction is LRU over slots nothing is bound to.
+  Loading an adapter rewrites ONE row of each stack in place (same Tensor
+  identity), so the compiled prefill/decode/verify executables never
+  retrace.
+- the batched-gather delta (`models/llama.py`) — per-request arena slots
+  ride the compiled steps as traced DATA (`[slots]` int32, like positions
+  and page tables), and every projection adds `x @ A[ids] @ B[ids] *
+  scale[ids]`; slot 0's zero rows make the base model's math bit-exact for
+  non-LoRA requests co-batched with LoRA ones.
+"""
+
+from .registry import (
+    TARGETS,
+    AdapterUnknown,
+    AdapterRegistry,
+    LoRAAdapter,
+    make_random,
+    target_dims,
+)
+from .arena import AdapterArena, AdapterArenaFull
+
+__all__ = [
+    "TARGETS",
+    "AdapterUnknown",
+    "AdapterArenaFull",
+    "AdapterRegistry",
+    "AdapterArena",
+    "LoRAAdapter",
+    "make_random",
+    "target_dims",
+]

@@ -1,0 +1,272 @@
+"""Paged adapter arena: device-resident LoRA stacks rationed like KV pages.
+
+The device side is a fixed set of stacked factors per (layer, target):
+`A [capacity+1, in, r_max]`, `B [capacity+1, r_max, out]`, plus one shared
+`scale [capacity+1]` — all jit implicit-state Tensors with STABLE Python
+identity, so the compiled serving executables close over them once and
+never retrace.  Loading an adapter rewrites one row of each stack in place
+(`t._data = t._data.at[slot].set(...)` — the same `_raw` slot the jit
+writeback uses), which changes VALUES without changing identity: zero
+recompiles under adapter churn.
+
+Slot 0 is the pinned base-model passthrough: all-zero factors, scale 0, so
+a gathered delta for id 0 is exactly zero and co-batched non-LoRA rows stay
+bit-identical to the base model.  Slots 1..capacity are refcounted by the
+same `PagePool` that rations KV pages: residency itself holds one ref (the
+prefix-cache idiom), every bound engine slot holds another, and eviction is
+LRU over slots at refcount 1 — an adapter some request is mid-decode on can
+never be evicted out from under it.
+
+Ranks below `r_max` zero-pad (exact — padded columns contribute nothing);
+targets an adapter does not provide stay zero rows (a zero delta IS the
+base projection).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .. import profiler
+from ..analysis import sanitizer as _san
+from ..inference.paging import PagePool
+from ..tensor import Tensor
+from .registry import TARGETS, target_dims
+
+
+class AdapterArenaFull(RuntimeError):
+    """Every arena slot is bound to an in-flight request — the load must
+    wait for a decode to finish.  Admission parks the request (retriable
+    backpressure, like page-pool pressure), it is never failed."""
+
+
+def _delta_add(y, x, ids, A, B, scale):
+    """`y + x @ A[ids] @ B[ids] * scale[ids]` — the batched-gather LoRA
+    delta.  `ids` is `[b]` int32 traced DATA (arena slots, like page
+    tables), so one executable serves every adapter mix.  Computed in the
+    stack dtype (f32) and cast back to y's dtype at the add."""
+    from ..ops.dispatch import apply
+
+    import jax.numpy as jnp
+
+    def f(ya, xa, ida, Aa, Ba, sa):
+        xf = xa.astype(Aa.dtype)
+        t = jnp.einsum("bsi,bir->bsr", xf, Aa[ida])
+        d = jnp.einsum("bsr,bro->bso", t, Ba[ida]) * sa[ida][:, None, None]
+        return ya + d.astype(ya.dtype)
+
+    return apply(f, [y, x, ids, A, B, scale], name="lora_delta_add")
+
+
+class _LayerView:
+    """One layer's slice of the arena, bound to this step's slot ids."""
+
+    __slots__ = ("_arena", "_layer", "_ids")
+
+    def __init__(self, arena, layer, ids):
+        self._arena = arena
+        self._layer = layer
+        self._ids = ids
+
+    def add(self, target, y, x):
+        """Base projection output `y` (from input `x`) plus this layer's
+        gathered delta for `target`."""
+        A, B = self._arena._stacks[(self._layer, target)]
+        return _delta_add(y, x, self._ids, A, B, self._arena._scale)
+
+
+class ArenaView:
+    """Per-dispatch binding of the arena to a `[b]` int32 slot-id Tensor;
+    the model asks it for per-layer views as it walks the decoder."""
+
+    __slots__ = ("_arena", "_ids")
+
+    def __init__(self, arena, ids):
+        self._arena = arena
+        self._ids = ids
+
+    def layer(self, i):
+        return _LayerView(self._arena, i, self._ids)
+
+
+class AdapterArena:
+    """Refcounted LRU arena of device-resident adapters over one registry.
+
+    All mutation (acquire/release/evict/upload) is serialized by `_mu`;
+    readers of the device stacks (the compiled steps) never need it — they
+    see whichever committed row values the last upload left, and the
+    engine's admission ordering guarantees a slot's row is fully written
+    before any request binds it.
+    """
+
+    def __init__(self, registry, capacity=None, rank_max=None):
+        from ..framework import core as _core
+
+        self.registry = registry
+        self.capacity = int(
+            _core.flag("FLAGS_serve_lora_capacity") if capacity is None else capacity
+        )
+        self.rank_max = int(
+            _core.flag("FLAGS_serve_lora_rank_max") if rank_max is None else rank_max
+        )
+        if self.capacity < 1:
+            raise ValueError("adapter arena needs capacity >= 1")
+        if self.rank_max < 1:
+            raise ValueError("adapter arena needs rank_max >= 1")
+        self._mu = threading.Lock()
+        # slot 0 = pinned base passthrough, exactly PagePool's scratch page
+        self._pool = PagePool(self.capacity + 1)
+        self._slot_of = {}     # adapter_id -> arena slot
+        self._adapter_at = {}  # arena slot -> LoRAAdapter
+        self._clock = 0
+        self._last_used = {}   # arena slot -> LRU tick
+        self._hits = 0
+        self._misses = 0
+        dims = target_dims(registry.config)
+        n = self.capacity + 1
+        self._stacks = {}
+        for layer in range(registry.num_layers):
+            for t in TARGETS:
+                d_in, d_out = dims[t]
+                A = Tensor(np.zeros((n, d_in, self.rank_max), np.float32))
+                B = Tensor(np.zeros((n, self.rank_max, d_out), np.float32))
+                A.stop_gradient = True
+                B.stop_gradient = True
+                self._stacks[(layer, t)] = (A, B)
+        self._scale = Tensor(np.zeros(n, np.float32))
+        self._scale.stop_gradient = True
+
+    def view(self, ids):
+        return ArenaView(self, ids)
+
+    # -- residency ----------------------------------------------------------
+
+    def acquire(self, adapter):
+        """Bind one request to `adapter`: incref its slot if resident, else
+        evict-if-needed + upload.  Returns the arena slot.  Raises
+        AdapterArenaFull when every slot is pinned by in-flight requests."""
+        with self._mu:
+            slot = self._slot_of.get(adapter.adapter_id)
+            if slot is not None:
+                self._pool.incref(slot)
+                self._tick_locked(slot)
+                self._hits += 1
+                profiler.record_lora_event("residency_hits")
+                return slot
+            self._misses += 1
+            profiler.record_lora_event("residency_misses")
+            if self._pool.free_count() == 0 and not self._evict_one_locked():
+                raise AdapterArenaFull(
+                    f"adapter arena full: {self.capacity} slots all bound to "
+                    "in-flight requests"
+                )
+            slot = self._pool.alloc()  # refcount 1 = the residency hold
+            self._upload_locked(slot, adapter)
+            self._slot_of[adapter.adapter_id] = slot
+            self._adapter_at[slot] = adapter
+            self._tick_locked(slot)
+            self._pool.incref(slot)  # the caller's binding ref
+            profiler.record_lora_event("loads")
+            profiler.record_lora_residency(len(self._slot_of), self.capacity)
+            return slot
+
+    def release(self, slot):
+        """Drop one request's binding ref.  The residency hold keeps the
+        refcount >= 1, so the adapter stays resident (warm) until LRU
+        eviction needs the slot."""
+        if slot == 0:
+            return
+        with self._mu:
+            self._pool.decref(slot)
+
+    def _tick_locked(self, slot):
+        self._clock += 1
+        self._last_used[slot] = self._clock
+
+    def _evict_one_locked(self):
+        """Evict the LRU resident adapter nothing is bound to (refcount ==
+        1, just the residency hold).  Returns the freed slot or None."""
+        victim = None
+        for aid, slot in self._slot_of.items():
+            if self._pool.refs[slot] != 1:
+                continue
+            if victim is None or self._last_used[slot] < self._last_used[victim[1]]:
+                victim = (aid, slot)
+        if victim is None:
+            return None
+        aid, slot = victim
+        del self._slot_of[aid]
+        del self._adapter_at[slot]
+        del self._last_used[slot]
+        self._pool.decref(slot)  # refcount 1 -> 0: back on the free list
+        profiler.record_lora_event("evictions")
+        profiler.record_lora_residency(len(self._slot_of), self.capacity)
+        return slot
+
+    def _upload_locked(self, slot, adapter):
+        """Rewrite arena row `slot` with the adapter's padded factors —
+        in-place `_data` updates on the SAME Tensors the executables closed
+        over, so values change with zero retraces.  Targets the adapter
+        does not provide are zeroed (stale rows from the slot's previous
+        tenant must not leak)."""
+        import jax.numpy as jnp
+
+        r = adapter.rank
+        with _san.allow("lora adapter arena upload (admission-time load)"):
+            for (layer, t), (A_t, B_t) in self._stacks.items():
+                w = adapter.weights.get((layer, t))
+                if w is None:
+                    A_row = jnp.zeros(A_t.shape[1:], jnp.float32)
+                    B_row = jnp.zeros(B_t.shape[1:], jnp.float32)
+                else:
+                    A, B = w
+                    A_row = jnp.zeros(A_t.shape[1:], jnp.float32).at[:, :r].set(A)
+                    B_row = jnp.zeros(B_t.shape[1:], jnp.float32).at[:r, :].set(B)
+                A_t._data = A_t._data.at[slot].set(A_row)
+                B_t._data = B_t._data.at[slot].set(B_row)
+            self._scale._data = self._scale._data.at[slot].set(adapter.scale)
+
+    # -- introspection ------------------------------------------------------
+
+    def slot_of(self, adapter_id):
+        """Resident arena slot for a stable adapter id, or None."""
+        with self._mu:
+            return self._slot_of.get(adapter_id)
+
+    def resident(self):
+        """Sorted resident adapter names (healthz / flight recorder)."""
+        with self._mu:
+            return sorted(a.name for a in self._adapter_at.values())
+
+    def stats(self):
+        with self._mu:
+            lookups = self._hits + self._misses
+            return {
+                "capacity": self.capacity,
+                "resident": len(self._slot_of),
+                "free": self._pool.free_count(),
+                "hit_rate": (self._hits / lookups) if lookups else 1.0,
+            }
+
+    def check_invariants(self, bindings):
+        """Refcount audit (FLAGS_serve_debug_invariants): `bindings` maps
+        arena slot -> number of engine slots currently bound to it.  Every
+        resident slot must hold exactly 1 (residency) + bindings refs, and
+        non-resident slots must be free."""
+        with self._mu:
+            expected = np.zeros(self.capacity + 1, np.int64)
+            expected[0] = 1  # pinned base slot
+            for slot in self._slot_of.values():
+                expected[slot] = 1 + int(bindings.get(slot, 0))
+            if not np.array_equal(expected, self._pool.refs):
+                raise AssertionError(
+                    f"adapter arena refcount mismatch: expected "
+                    f"{expected.tolist()}, pool has {self._pool.refs.tolist()}"
+                )
+            free = set(range(1, self.capacity + 1)) - set(self._slot_of.values())
+            if free != set(self._pool._free):
+                raise AssertionError(
+                    f"adapter arena free list {sorted(self._pool._free)} != "
+                    f"non-resident slots {sorted(free)}"
+                )

@@ -326,6 +326,15 @@ def _page_decode_write(arena_t, new_t, tables_t, pos_t):
     return apply(f, [arena_t, new_t, tables_t, pos_t], name="kv_page_decode_write")
 
 
+def _lora_add(lora, target, y, x):
+    """Base projection output `y` (computed from `x`) plus the batched-
+    gather LoRA delta for `target` (ISSUE 12).  `lora` is a per-layer
+    arena view carrying this dispatch's `[b]` int32 adapter-slot ids as
+    traced data; None (training, non-LoRA serving) is an exact
+    passthrough — the traced program is byte-identical to pre-LoRA."""
+    return y if lora is None else lora.add(target, y, x)
+
+
 class LlamaMLP(nn.Layer):
     def __init__(self, config):
         super().__init__()
@@ -339,8 +348,13 @@ class LlamaMLP(nn.Layer):
             self.up_proj = nn.Linear(h, i, bias_attr=False)
             self.down_proj = nn.Linear(i, h, bias_attr=False)
 
-    def forward(self, x):
-        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+    def forward(self, x, lora=None):
+        if lora is None:
+            return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+        h = F.silu(_lora_add(lora, "gate_proj", self.gate_proj(x), x)) * _lora_add(
+            lora, "up_proj", self.up_proj(x), x
+        )
+        return _lora_add(lora, "down_proj", self.down_proj(h), h)
 
 
 class LlamaAttention(nn.Layer):
@@ -364,11 +378,17 @@ class LlamaAttention(nn.Layer):
             self.o_proj = nn.Linear(h, h, bias_attr=False)
         self.rope_cos, self.rope_sin = rope
 
-    def forward(self, x, attn_mask=None, cache=None, pos=None):
+    def forward(self, x, attn_mask=None, cache=None, pos=None, lora=None):
         b, s = x.shape[0], x.shape[1]
-        q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
-        k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
-        v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        q = _lora_add(lora, "q_proj", self.q_proj(x), x).reshape(
+            [b, s, self.num_heads, self.head_dim]
+        )
+        k = _lora_add(lora, "k_proj", self.k_proj(x), x).reshape(
+            [b, s, self.num_kv_heads, self.head_dim]
+        )
+        v = _lora_add(lora, "v_proj", self.v_proj(x), x).reshape(
+            [b, s, self.num_kv_heads, self.head_dim]
+        )
         if isinstance(cache, PagedPrefillView):
             if cache.start is None:
                 # fresh paged prefill: identical math to the dense SlotView
@@ -402,7 +422,7 @@ class LlamaAttention(nn.Layer):
                     cache.table.reshape([1, -1]), cache.start, cache.max_len,
                 )
             out = out.reshape([b, s, self.num_heads * self.head_dim])
-            return self.o_proj(out), cache
+            return _lora_add(lora, "o_proj", self.o_proj(out), out), cache
         if isinstance(cache, PagedDecodeView):
             # paged compiled decode: same per-row rope and attended geometry
             # as the dense StaticKVCache path; the gather through the page
@@ -418,7 +438,7 @@ class LlamaAttention(nn.Layer):
                 q, cache.arena.k, cache.arena.v, cache.tables, pos, cache.max_len
             )
             out = out.reshape([b, s, self.num_heads * self.head_dim])
-            return self.o_proj(out), cache
+            return _lora_add(lora, "o_proj", self.o_proj(out), out), cache
         if isinstance(cache, SlotView):
             # compiled prefill into a pooled cache: the prompt attends to
             # itself (plain causal attention) while its K/V are written into
@@ -429,7 +449,7 @@ class LlamaAttention(nn.Layer):
             cache.pool.v._data = _slot_write(cache.pool.v, v, cache.slot)._data
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
             out = out.reshape([b, s, self.num_heads * self.head_dim])
-            return self.o_proj(out), cache
+            return _lora_add(lora, "o_proj", self.o_proj(out), out), cache
         if isinstance(cache, StaticKVCache):
             # compiled decode path: fixed-shape cache, position as data;
             # cache validity rides the flash_decode kernel (in-kernel
@@ -440,7 +460,7 @@ class LlamaAttention(nn.Layer):
             cache.v._data = _cache_write(cache.v, v, pos)._data
             out = F.flash_decode(q, cache.k, cache.v, pos)
             out = out.reshape([b, s, self.num_heads * self.head_dim])
-            return self.o_proj(out), cache
+            return _lora_add(lora, "o_proj", self.o_proj(out), out), cache
         offset = 0
         if cache is not None:
             offset = cache[0].shape[1]
@@ -509,12 +529,14 @@ class LlamaDecoderLayer(nn.Layer):
         h = x + self.self_attn(self.input_layernorm(x), attn_mask)
         return h + self.mlp(self.post_attention_layernorm(h))
 
-    def forward(self, x, attn_mask=None, cache=None, pos=None):
+    def forward(self, x, attn_mask=None, cache=None, pos=None, lora=None):
         if cache is not None:
             residual = x
-            attn_out, new_cache = self.self_attn(self.input_layernorm(x), attn_mask, cache, pos)
+            attn_out, new_cache = self.self_attn(
+                self.input_layernorm(x), attn_mask, cache, pos, lora=lora
+            )
             h = residual + attn_out
-            out = h + self.mlp(self.post_attention_layernorm(h))
+            out = h + self.mlp(self.post_attention_layernorm(h), lora=lora)
             return out, new_cache
         if self.config.use_recompute and self.training:
             from ..incubate.recompute import recompute
@@ -537,7 +559,7 @@ class LlamaModel(nn.Layer):
         )
         self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
 
-    def forward(self, input_ids, attn_mask=None, caches=None, pos=None):
+    def forward(self, input_ids, attn_mask=None, caches=None, pos=None, lora=None):
         x = self.embed_tokens(input_ids)
         if self.config.sequence_parallel:
             from ..distributed.fleet.meta_parallel.sp_utils import ScatterOp
@@ -546,7 +568,10 @@ class LlamaModel(nn.Layer):
         new_caches = [] if caches is not None else None
         for i, layer in enumerate(self.layers):
             if caches is not None:
-                x, c = layer(x, attn_mask, caches[i], pos)
+                x, c = layer(
+                    x, attn_mask, caches[i], pos,
+                    lora=lora.layer(i) if lora is not None else None,
+                )
                 new_caches.append(c)
             else:
                 x = layer(x, attn_mask)

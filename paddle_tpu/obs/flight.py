@@ -171,6 +171,11 @@ def dump(reason, path=None):
             spec = _prof.speculation_summary()
             if spec:
                 header["speculation"] = spec
+            # adapter-arena residency at death: "which tenants were loaded,
+            # was the arena thrashing" is the multi-tenant analogue
+            lora = _prof.lora_summary()
+            if lora:
+                header["lora"] = lora
         except Exception:
             pass
         with open(path, "w") as f:

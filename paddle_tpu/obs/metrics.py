@@ -30,6 +30,9 @@ Metric-name reference (the stable surface the scrape test pins):
     paddle_spec_steps_total / paddle_spec_proposed_tokens_total
     paddle_spec_accepted_tokens_total / paddle_spec_emitted_tokens_total
     paddle_spec_acceptance_rate / paddle_spec_tokens_per_step
+    paddle_lora_loads_total / paddle_lora_evictions_total
+    paddle_lora_residency_hits_total / _misses_total
+    paddle_lora_resident / paddle_lora_capacity
     paddle_router_requests_total, _retries_total, _failovers_total,
     paddle_router_breaker_trips_total / _half_open_total / _closes_total
     paddle_router_hedges_total / _hedge_wins_total
@@ -187,6 +190,21 @@ def render(labels=None):
     exp.add("paddle_spec_tokens_per_step",
             (g["emitted"] / g["slot_steps"]) if g["slot_steps"] else 0.0,
             "mean emitted tokens per slot-step (1.0 = no speculation win)",
+            "gauge")
+
+    g = snap["lora"]
+    exp.add("paddle_lora_loads_total", g["loads"],
+            "LoRA adapter uploads into arena slots")
+    exp.add("paddle_lora_evictions_total", g["evictions"],
+            "LRU evictions of idle resident LoRA adapters")
+    exp.add("paddle_lora_residency_hits_total", g["residency_hits"],
+            "adapter acquires that found the adapter already resident")
+    exp.add("paddle_lora_residency_misses_total", g["residency_misses"],
+            "adapter acquires that had to upload (or park on a full arena)")
+    exp.add("paddle_lora_resident", g["resident"],
+            "LoRA adapters currently resident in the arena", "gauge")
+    exp.add("paddle_lora_capacity", g["capacity"],
+            "LoRA arena adapter slots (excludes the pinned base slot)",
             "gauge")
 
     g = snap["router"]

@@ -262,6 +262,7 @@ def reset():
         _reset_serving_locked()
         _reset_paging_locked()
         _reset_speculation_locked()
+        _reset_lora_locked()
         _reset_router_locked()
         _flash_fallbacks.clear()
 
@@ -282,6 +283,7 @@ def metrics_snapshot():
             "serving": serving,
             "paging": dict(_paging_gauges),
             "speculation": dict(_spec_gauges),
+            "lora": dict(_lora_gauges),
             "router": router,
             "flash_fallbacks": dict(_flash_fallbacks),
         }
@@ -414,6 +416,70 @@ def speculation_summary():
 
 
 # ---------------------------------------------------------------------------
+# LoRA-serving gauges (ISSUE 12): the adapter arena counts residency
+# lookups (hit = adapter already device-resident, miss = a load was
+# needed), uploads, and LRU evictions, plus resident/capacity gauges — so
+# "is the arena thrashing" is answerable from the summary, /metrics, and
+# the flight-recorder header.
+# ---------------------------------------------------------------------------
+
+_lora_gauges = {
+    "loads": 0,            # adapter uploads into an arena slot
+    "evictions": 0,        # LRU evictions of an idle resident adapter
+    "residency_hits": 0,   # acquire() found the adapter resident
+    "residency_misses": 0, # acquire() had to load (or park)
+    "resident": 0,         # adapters currently resident (gauge)
+    "capacity": 0,         # arena slots (gauge; excludes the base slot)
+}
+
+
+def record_lora_event(kind, n=1):
+    """Count one adapter-arena event: 'loads', 'evictions',
+    'residency_hits', 'residency_misses' (unknown kinds are counted too so
+    call sites never have to guard)."""
+    with _counters_lock:
+        g = _lora_gauges
+        g[kind] = g.get(kind, 0) + int(n)
+
+
+def record_lora_residency(resident, capacity):
+    """Latest resident-adapter count and arena capacity."""
+    with _counters_lock:
+        _lora_gauges["resident"] = int(resident)
+        _lora_gauges["capacity"] = int(capacity)
+
+
+def _reset_lora_locked():
+    for k in _lora_gauges:
+        _lora_gauges[k] = 0
+
+
+def reset_lora():
+    with _counters_lock:
+        _reset_lora_locked()
+
+
+def lora_summary():
+    """Aggregated multi-tenant LoRA metrics: residency hit rate, loads,
+    evictions, resident/capacity.  Empty dict before any acquire."""
+    with _counters_lock:
+        g = dict(_lora_gauges)
+    lookups = g["residency_hits"] + g["residency_misses"]
+    if not lookups and not g["loads"]:
+        return {}
+    out = {
+        "loads": g["loads"],
+        "evictions": g["evictions"],
+        "resident": g["resident"],
+        "capacity": g["capacity"],
+    }
+    if lookups:
+        out["residency_lookups"] = lookups
+        out["residency_hit_rate"] = g["residency_hits"] / lookups
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Router gauges (ISSUE 9): the multi-replica serving router counts every
 # routed request, retry/failover, breaker transition, hedge, and brownout
 # shed, plus a per-replica state snapshot — so "which replica is sick and
@@ -503,6 +569,9 @@ def serving_summary():
     spec = speculation_summary()
     if spec:
         out["speculation"] = spec
+    lora = lora_summary()
+    if lora:
+        out["lora"] = lora
     return out
 
 

@@ -94,6 +94,7 @@ class Replica:
         self._drain_estimate_s = 0.0
         self._page_free_frac = 1.0
         self._decode_ewma_ms = 0.0
+        self._lora_adapters = ()  # resident adapter names from healthz (ISSUE 12)
         self._probes_ok = 0
         self._probes_failed = 0
 
@@ -126,6 +127,7 @@ class Replica:
                 "drain_estimate_s": self._drain_estimate_s,
                 "page_free_frac": self._page_free_frac,
                 "decode_ewma_ms": self._decode_ewma_ms,
+                "lora_adapters": self._lora_adapters,
                 "probes_ok": self._probes_ok,
                 "probes_failed": self._probes_failed,
             }
@@ -253,6 +255,9 @@ class Replica:
             self._drain_estimate_s = float(h.get("drain_estimate_s", 0.0))
             self._page_free_frac = float(h.get("page_free_frac", 1.0))
             self._decode_ewma_ms = float(h.get("decode_ewma_ms", 0.0))
+            lora = h.get("lora")
+            if isinstance(lora, dict):
+                self._lora_adapters = tuple(lora.get("adapters", ()))
         if state == "ready":
             self.record_success()
         elif state == "dead":
@@ -376,6 +381,12 @@ def main(argv=None):
     p.add_argument("--buckets", default="8,16")
     p.add_argument("--queue-depth", type=int, default=32)
     p.add_argument("--seed", type=int, default=1234)
+    p.add_argument(
+        "--lora", default="",
+        help="comma list of adapter specs name[:rank] to register and serve "
+             "(forces the paged engine; weights are seeded by list position, "
+             "so identical --lora strings mean identical adapters fleet-wide)",
+    )
     args = p.parse_args(argv)
 
     import numpy as np
@@ -388,6 +399,22 @@ def main(argv=None):
     from ..models.llama import LlamaConfig, LlamaForCausalLM
 
     model = LlamaForCausalLM(LlamaConfig.tiny())
+    extra = {}
+    if args.lora:
+        # same --lora string on every worker -> same registration order ->
+        # same seeds -> bit-identical adapter weights (the failover contract
+        # extends to LoRA outputs)
+        from ..lora import AdapterArena, AdapterRegistry, make_random
+
+        reg = AdapterRegistry(model.config)
+        for i, spec in enumerate(args.lora.split(",")):
+            name, _, rank = spec.partition(":")
+            make_random(reg, name, rank=int(rank) if rank else 4, seed=i + 1)
+        extra = {
+            "paged": True,
+            "page_size": 8,
+            "lora": AdapterArena(reg),
+        }
     eng = ContinuousBatchingEngine(
         model,
         slots=args.slots,
@@ -395,6 +422,7 @@ def main(argv=None):
         prefill_buckets=[int(b) for b in args.buckets.split(",")],
         queue_depth=args.queue_depth,
         seed=0,
+        **extra,
     )
     eng.warmup()
     serve(eng, port=args.port, host=args.host, block=True, handle_signals=True)

@@ -175,11 +175,15 @@ class Router:
 
     # -- selection -----------------------------------------------------------
 
-    def pick(self, exclude=()):
+    def pick(self, exclude=(), adapter=None):
         """Least-loaded ready replica whose breaker admits traffic: score by
-        (drain estimate, queued+active work, EWMA latency).  Breaker gates
-        are consumed in score order so a half-open trial slot is only spent
-        on the replica actually chosen."""
+        (adapter residency, drain estimate, queued+active work, EWMA
+        latency).  When the request names a LoRA adapter, replicas whose
+        last probe reported it resident sort FIRST — a miss is still
+        eligible (every replica loads on demand at admission), it just only
+        wins when every resident replica is excluded or breaker-gated.
+        Breaker gates are consumed in score order so a half-open trial slot
+        is only spent on the replica actually chosen."""
         cands = []
         for i, rep in enumerate(self.replicas):
             if rep.rid in exclude:
@@ -187,14 +191,16 @@ class Router:
             s = rep.snapshot()
             if s["state"] != "ready" or s["admin_draining"]:
                 continue
+            miss = 0 if not adapter else int(adapter not in s["lora_adapters"])
             cands.append((
+                miss,
                 s["drain_estimate_s"],
                 s["queue_depth"] + s["active_slots"],
                 s["ewma_latency_s"],
                 i,
                 rep,
             ))
-        for *_, rep in sorted(cands, key=lambda c: c[:4]):
+        for *_, rep in sorted(cands, key=lambda c: c[:5]):
             if rep.allow():
                 return rep
         return None
@@ -320,12 +326,13 @@ class Router:
                         trace_id=tid,
                     )
             t_pick = time.perf_counter()
-            rep = self.pick(exclude=tried)
+            adapter = payload.get("adapter") if isinstance(payload, dict) else None
+            rep = self.pick(exclude=tried, adapter=adapter)
             if rep is None and tried:
                 # every distinct replica was tried; with budget left, allow
                 # a second pass (a restarted replica may be back)
                 tried = set()
-                rep = self.pick()
+                rep = self.pick(adapter=adapter)
             _obs.record(
                 "router.pick", tid, t0=t_pick, t1=time.perf_counter(),
                 parent_id=admit_sid, attempt=attempt,
@@ -453,7 +460,10 @@ class Router:
         t1 = threading.Thread(target=_run, args=(rep,), daemon=True)
         t1.start()
         if not first_done.wait(self.hedge_s):
-            alt = self.pick(exclude={rep.rid})
+            alt = self.pick(
+                exclude={rep.rid},
+                adapter=payload.get("adapter") if isinstance(payload, dict) else None,
+            )
             if alt is not None:
                 _prof.record_router_event("hedges")
                 t2 = threading.Thread(target=_run, args=(alt,), daemon=True)

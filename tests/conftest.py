@@ -40,6 +40,7 @@ _SANITIZED_MODULES = {
     "test_async_pipeline",
     "test_observability",
     "test_spec_decode",
+    "test_lora_serving",
 }
 
 

@@ -1,0 +1,394 @@
+"""Multi-tenant LoRA serving (ISSUE 12): adapter registry validation, the
+paged adapter arena's refcount/LRU invariants, mixed-adapter co-batching
+with bit-identity to single-adapter engines, zero-recompile adapter churn,
+warm-restart residency, per-adapter prefix-cache isolation, speculative
+decoding composition, the serve()/router HTTP surface (typed 404 for
+unknown adapters, adapter-resident replica preference), and the /metrics
+exposition.
+
+Runs under the runtime sanitizer (conftest _SANITIZED_MODULES): arena
+uploads are an allowed admission-time event; anything else that traces or
+host-syncs in steady state fails the suite.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler as prof
+from paddle_tpu.inference import serve
+from paddle_tpu.inference.engine import ContinuousBatchingEngine
+from paddle_tpu.lora import (
+    AdapterArena,
+    AdapterArenaFull,
+    AdapterRegistry,
+    AdapterUnknown,
+    make_random,
+)
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="module")
+def model():
+    np.random.seed(1234)
+    return LlamaForCausalLM(LlamaConfig.tiny())
+
+
+def _prompt(n, seed=0):
+    return np.random.RandomState(seed).randint(1, 250, size=n).astype(np.int32)
+
+
+def _registry(model, n=2, rank=4, scale=0.02):
+    reg = AdapterRegistry(model.config)
+    for i in range(n):
+        make_random(reg, f"a{i + 1}", rank=rank, seed=i + 1, scale=scale)
+    return reg
+
+
+def _engine(model, **kw):
+    kw.setdefault("slots", 3)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prefill_buckets", [8, 16])
+    kw.setdefault("queue_depth", 16)
+    kw.setdefault("seed", 0)
+    kw.setdefault("paged", True)
+    kw.setdefault("page_size", 8)
+    return ContinuousBatchingEngine(model, **kw)
+
+
+@pytest.fixture()
+def _invariants():
+    paddle.set_flags({"FLAGS_serve_debug_invariants": True})
+    try:
+        yield
+    finally:
+        paddle.set_flags({"FLAGS_serve_debug_invariants": False})
+
+
+# ---------------------------------------------------------------------------
+# registry: validation, stable ids, typed miss
+# ---------------------------------------------------------------------------
+
+
+def test_registry_ids_validation_and_unknown(model):
+    reg = _registry(model, n=2)
+    a1, a2 = reg.resolve("a1"), reg.resolve("a2")
+    assert (a1.adapter_id, a2.adapter_id) == (1, 2)  # ids from 1; 0 = base
+    assert reg.resolve(2) is a2  # stable-id resolution
+    assert reg.names() == ["a1", "a2"] and len(reg) == 2
+    with pytest.raises(AdapterUnknown) as ei:
+        reg.resolve("nope")
+    assert ei.value.adapter == "nope"
+    # shape validation: A must be [in_features, rank]
+    d_in, _ = reg.dims["q_proj"]
+    bad = {(0, "q_proj"): (np.zeros((d_in, 3), np.float32),
+                           np.zeros((4, d_in), np.float32))}
+    with pytest.raises(ValueError, match="A shape"):
+        reg.register("bad", bad, rank=4)
+    with pytest.raises(ValueError, match="already registered"):
+        make_random(reg, "a1", seed=9)
+
+
+# ---------------------------------------------------------------------------
+# arena: refcounts, LRU eviction, full-arena backpressure
+# ---------------------------------------------------------------------------
+
+
+def test_arena_refcount_lru_and_invariants(model):
+    reg = _registry(model, n=3, rank=2)
+    arena = AdapterArena(reg, capacity=2, rank_max=4)
+    a1, a2, a3 = (reg.resolve(f"a{i}") for i in (1, 2, 3))
+    s1 = arena.acquire(a1)
+    s2 = arena.acquire(a2)
+    assert s1 != s2 and arena.resident() == ["a1", "a2"]
+    arena.check_invariants({s1: 1, s2: 1})
+    # both bound -> nothing at refcount 1 -> full
+    with pytest.raises(AdapterArenaFull):
+        arena.acquire(a3)
+    # releasing a1 leaves it resident (warm) but evictable
+    arena.release(s1)
+    arena.check_invariants({s2: 1})
+    assert arena.resident() == ["a1", "a2"]
+    # a2 release + re-acquire bumps its LRU tick above a1's
+    arena.release(s2)
+    assert arena.acquire(a2) == s2
+    s3 = arena.acquire(a3)
+    assert s3 == s1  # LRU victim was a1
+    assert arena.resident() == ["a2", "a3"]
+    arena.check_invariants({s2: 1, s3: 1})
+    # re-acquiring a resident adapter is a hit, not a load
+    assert arena.acquire(a2) == s2
+    arena.check_invariants({s2: 2, s3: 1})
+    st = arena.stats()
+    assert st["resident"] == 2 and st["capacity"] == 2
+    assert 0.0 < st["hit_rate"] < 1.0
+
+
+def test_arena_full_parks_admission_until_slot_frees(model, _invariants):
+    reg = _registry(model, n=3, rank=2)
+    eng = _engine(model, lora=AdapterArena(reg, capacity=2, rank_max=4))
+    try:
+        reqs = [
+            eng.submit(_prompt(10, seed=i), max_new_tokens=4, adapter=f"a{i}")
+            for i in (1, 2, 3)
+        ]
+        eng.run_until_idle()
+        outs = [r.wait(1) for r in reqs]  # the parked third request completes
+        assert all(o.size == 10 + 4 for o in outs)  # prompt + generated
+        assert eng.healthz()["lora"]["resident"] == 2
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# engine: mixed co-batch bit-identity, zero-recompile churn, restart
+# ---------------------------------------------------------------------------
+
+
+def test_mixed_cobatch_bit_identity_zero_recompiles(model, _invariants):
+    reg = _registry(model, n=2)
+    eng = _engine(model, lora=AdapterArena(reg, capacity=4))
+    try:
+        eng.warmup()
+        warm = eng.compile_counts()
+        reqs = [
+            eng.submit(_prompt(10, seed=5), max_new_tokens=6),
+            eng.submit(_prompt(10, seed=6), max_new_tokens=6, adapter="a1"),
+            eng.submit(_prompt(10, seed=7), max_new_tokens=6, adapter="a2"),
+        ]
+        eng.run_until_idle()
+        mixed = [r.wait(1).tolist() for r in reqs]
+        assert eng.compile_counts() == warm  # one executable, any adapter mix
+        assert len({tuple(m) for m in mixed}) == 3  # adapters actually differ
+    finally:
+        eng.stop()
+    # each adapter row is bit-identical to a single-adapter engine's output
+    for name, idx, seed in (("a1", 1, 6), ("a2", 2, 7)):
+        reg2 = AdapterRegistry(model.config)
+        make_random(reg2, name, rank=4, seed=idx)
+        e2 = _engine(model, lora=AdapterArena(reg2, capacity=2))
+        try:
+            out = e2.generate(_prompt(10, seed=seed), max_new_tokens=6,
+                              adapter=name)
+            assert out.tolist() == mixed[idx]
+        finally:
+            e2.stop()
+    # and the base row is bit-identical to a no-LoRA engine
+    e0 = _engine(model)
+    try:
+        assert e0.generate(_prompt(10, seed=5),
+                           max_new_tokens=6).tolist() == mixed[0]
+    finally:
+        e0.stop()
+
+
+def test_adapter_churn_evicts_without_recompiles(model, _invariants):
+    # 6 adapters through a 3-slot arena: every wrap-around evicts and
+    # re-uploads, values change, executables never retrace
+    reg = _registry(model, n=6, rank=2)
+    eng = _engine(model, slots=2, lora=AdapterArena(reg, capacity=3, rank_max=4))
+    try:
+        eng.warmup()
+        warm = eng.compile_counts()
+        prof.reset_lora()
+        outs = {}
+        for rnd in range(2):
+            for i in range(1, 7):
+                out = eng.generate(_prompt(10, seed=i), max_new_tokens=3,
+                                   adapter=f"a{i}").tolist()
+                if rnd:
+                    assert outs[i] == out  # reload reproduces exactly
+                outs[i] = out
+        assert eng.compile_counts() == warm
+        g = prof.lora_summary()
+        assert g["evictions"] >= 6  # capacity 3 < 6 tenants -> churn
+        assert g["loads"] >= 9
+    finally:
+        eng.stop()
+
+
+def test_sixteen_adapters_cobatch_one_decode(model, _invariants):
+    # the ISSUE 12 acceptance bar: 16 distinct adapters resident at once,
+    # all co-batched through the ONE compiled decode step, zero recompiles
+    # strong factors so rank-2 deltas actually flip greedy argmaxes on the
+    # tiny model — the distinctness check below is a proxy for "every slot
+    # gathered ITS OWN adapter row", not a numerics bar
+    reg = _registry(model, n=16, rank=2, scale=0.1)
+    eng = _engine(model, slots=16, max_len=32, prefill_buckets=[8],
+                  queue_depth=32, lora=AdapterArena(reg, capacity=16, rank_max=4))
+    try:
+        eng.warmup()
+        warm = eng.compile_counts()
+        reqs = [
+            eng.submit(_prompt(6, seed=99), max_new_tokens=6,
+                       adapter=f"a{i}")
+            for i in range(1, 17)
+        ]
+        eng.run_until_idle()
+        outs = [tuple(r.wait(1).tolist()) for r in reqs]
+        assert eng.compile_counts() == warm
+        assert len(set(outs)) >= 12  # same prompt, overwhelmingly distinct
+        assert eng.healthz()["lora"]["resident"] == 16
+    finally:
+        eng.stop()
+
+
+def test_unknown_adapter_rejected_at_submit(model):
+    reg = _registry(model, n=1)
+    eng = _engine(model, lora=AdapterArena(reg, capacity=2))
+    try:
+        with pytest.raises(AdapterUnknown):
+            eng.submit(_prompt(8), max_new_tokens=2, adapter="nope")
+        with pytest.raises(ValueError, match="no LoRA arena"):
+            _engine(model).submit(_prompt(8), max_new_tokens=2, adapter="a1")
+    finally:
+        eng.stop()
+
+
+def test_warm_restart_keeps_adapters_resident(model, _invariants):
+    reg = _registry(model, n=2)
+    arena = AdapterArena(reg, capacity=4)
+    eng = _engine(model, lora=arena)
+    try:
+        eng.warmup()
+        warm = eng.compile_counts()
+        eng.generate(_prompt(10, seed=6), max_new_tokens=3, adapter="a1")
+        eng.generate(_prompt(10, seed=7), max_new_tokens=3, adapter="a2")
+        before = arena.resident()
+        eng.restart(reason="drill")
+        assert arena.resident() == before  # residency survives the restart
+        out = eng.generate(_prompt(10, seed=6), max_new_tokens=3, adapter="a1")
+        assert out.size == 10 + 3
+        assert eng.compile_counts() == warm
+    finally:
+        eng.stop()
+
+
+def test_prefix_cache_isolated_per_adapter(model, _invariants):
+    reg = _registry(model, n=2)
+    eng = _engine(model, lora=AdapterArena(reg, capacity=4))
+    try:
+        base = _prompt(12, seed=42)
+
+        def go(tail_seed, adapter):
+            p = np.concatenate([base, _prompt(4, seed=tail_seed)])
+            eng.generate(p.astype(np.int32), max_new_tokens=2, adapter=adapter)
+
+        go(43, "a1")
+        prof.reset_paging()
+        go(44, "a2")  # same token prefix, different adapter: MUST miss
+        assert prof.paging_summary()["prefix_hits"] == 0
+        prof.reset_paging()
+        go(45, "a1")  # same adapter again: shares within the tenant
+        assert prof.paging_summary()["prefix_hits"] == 1
+    finally:
+        eng.stop()
+
+
+def test_spec_decode_composes_with_mixed_adapters(model, _invariants):
+    reg = _registry(model, n=2)
+    paddle.set_flags({"FLAGS_serve_spec_k": 3})
+    try:
+        eng = _engine(model, slots=2, lora=AdapterArena(reg, capacity=3))
+        try:
+            eng.warmup()
+            warm = eng.compile_counts()
+            assert warm["verify"] == 1
+            r1 = eng.submit(_prompt(10, seed=6), max_new_tokens=8, adapter="a1")
+            r2 = eng.submit(_prompt(10, seed=7), max_new_tokens=8, adapter="a2")
+            eng.run_until_idle()
+            o1, o2 = r1.wait(1).tolist(), r2.wait(1).tolist()
+            assert eng.compile_counts() == warm
+        finally:
+            eng.stop()
+    finally:
+        paddle.set_flags({"FLAGS_serve_spec_k": 0})
+    # speculative greedy output == plain greedy output, per adapter
+    plain = _engine(model, slots=2, lora=AdapterArena(reg, capacity=3))
+    try:
+        assert plain.generate(_prompt(10, seed=6), max_new_tokens=8,
+                              adapter="a1").tolist() == o1
+        assert plain.generate(_prompt(10, seed=7), max_new_tokens=8,
+                              adapter="a2").tolist() == o2
+    finally:
+        plain.stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: serve() adapter field + typed 404, healthz/metrics, router
+# ---------------------------------------------------------------------------
+
+
+def _post(url, body, timeout=60):
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_serve_adapter_field_and_unknown_404(model):
+    reg = _registry(model, n=1)
+    eng = _engine(model, lora=AdapterArena(reg, capacity=2))
+    srv = serve(eng, port=0, block=False, supervise=False,
+                handle_signals=False)
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        status, body = _post(
+            url, {"input_ids": _prompt(8).tolist(), "max_new_tokens": 3,
+                  "adapter": "a1"},
+        )
+        assert status == 200 and len(body["tokens"]) == 8 + 3
+        status, body = _post(
+            url, {"input_ids": _prompt(8).tolist(), "max_new_tokens": 3,
+                  "adapter": "ghost"},
+        )
+        assert status == 404
+        assert body["type"] == "AdapterUnknown"
+        assert body["retriable"] is False
+        assert "ghost" in body["error"]
+        assert len(body["trace_id"]) == 16  # typed errors join the trace
+        # healthz surfaces arena residency for the router's probe
+        with urllib.request.urlopen(url + "/healthz", timeout=10) as r:
+            h = json.loads(r.read())
+        assert h["lora"]["adapters"] == ["a1"]
+        # /metrics exports the paddle_lora_* family
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        for name in ("paddle_lora_loads_total", "paddle_lora_resident",
+                     "paddle_lora_residency_hits_total"):
+            assert name in text
+    finally:
+        try:
+            srv.engine.stop()
+        except Exception:
+            pass
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_router_pick_prefers_adapter_resident_replica():
+    from paddle_tpu.serving.replica import Replica
+    from paddle_tpu.serving.router import Router
+
+    r_base = Replica("r0", "http://unit-0")
+    r_lora = Replica("r1", "http://unit-1")
+    # r0 is otherwise the better candidate (less load) but lacks the adapter
+    r_base._note_healthz({"status": "ready", "queue_depth": 0})
+    r_lora._note_healthz({"status": "ready", "queue_depth": 3,
+                          "lora": {"adapters": ["a1", "a2"]}})
+    router = Router([r_base, r_lora])
+    assert router.pick() is r_base  # no adapter: least-loaded wins
+    assert router.pick(adapter="a1") is r_lora  # residency outranks load
+    # a miss is still eligible when the resident replica is excluded
+    # (load-then-admit: the replica uploads at admission)
+    assert router.pick(adapter="a1", exclude={"r1"}) is r_base
+    assert router.pick(adapter="zz") is r_base  # nobody resident: by load

@@ -752,3 +752,130 @@ def _free_port():
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+# ---------------------------------------------------------------------------
+# chaos drill (slow): kill -9 under MIXED-ADAPTER load (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_kill9_chaos_drill_mixed_adapters(model, tmp_path):
+    """ISSUE 12 rides the kill -9 drill: both subprocess replicas boot with
+    ``--lora a1,a2,a3,a4`` (identical spec string -> position-seeded,
+    bit-identical adapter weights fleet-wide), the Poisson load cycles the
+    four tenants, and the injected SIGKILL takes one replica mid-stream.
+    Every request resolves exactly once; every 200 is bit-identical to a
+    single-process LoRA engine serving the same tenant (the failover
+    contract extends to adapter outputs); after the kill the survivor
+    advertises its resident tenants through /healthz so adapter-aware
+    ``pick()`` keeps scoring residency; an unknown tenant fails typed —
+    404 AdapterUnknown, retriable=false, no retry storm."""
+    from paddle_tpu.lora import AdapterArena, AdapterRegistry, make_random
+
+    adapters = ["a1", "a2", "a3", "a4"]
+
+    # single-process reference engine: the same registration order + seeds
+    # the workers derive from the identical --lora string
+    reg = AdapterRegistry(model.config)
+    for i, name in enumerate(adapters):
+        make_random(reg, name, rank=4, seed=i + 1)
+    ref_eng = ContinuousBatchingEngine(
+        model, slots=2, max_len=64, prefill_buckets=[8, 16], queue_depth=32,
+        seed=0, paged=True, page_size=8, lora=AdapterArena(reg),
+    )
+    n_requests = 16
+    refs = []
+    for i in range(n_requests):
+        p = _prompt(6, seed=1000 + i)
+        refs.append(ref_eng.generate(p, max_new_tokens=4,
+                                     adapter=adapters[i % len(adapters)]))
+
+    procs = [
+        ReplicaProcess(i, _free_port(), log_dir=str(tmp_path / "logs"),
+                       extra_args=("--lora", ",".join(adapters))).start()
+        for i in range(2)
+    ]
+    reps = [Replica(f"r{i}", rp.url, process=rp) for i, rp in enumerate(procs)]
+    router = Router(reps, probe_interval=0.1, retry_backoff=0.02)
+    try:
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            router.probe_once()
+            if all(r.state == "ready" for r in reps):
+                break
+            time.sleep(0.5)
+        assert all(r.state == "ready" for r in reps), "replicas never booted"
+        router.start()
+
+        results = []
+        results_mu = threading.Lock()
+        rng = np.random.RandomState(7)
+
+        def _load():
+            for i in range(n_requests):
+                time.sleep(float(rng.exponential(0.05)))  # Poisson arrivals
+                p = _prompt(6, seed=1000 + i)
+                status, body, _ = router.handle_generate(
+                    {"input_ids": p.tolist(), "max_new_tokens": 4,
+                     "adapter": adapters[i % len(adapters)]}
+                )
+                with results_mu:
+                    results.append((i, status, body))
+
+        threads = [threading.Thread(target=_load, daemon=True) for _ in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # mixed-tenant load in flight...
+        finj.arm("router.replica.kill:1")  # ...then SIGKILL one replica
+        for t in threads:
+            t.join(300)
+        assert not any(t.is_alive() for t in threads)
+
+        # exactly once: one resolution per submitted request
+        assert len(results) == 2 * n_requests
+        ok = 0
+        for i, status, body in results:
+            if status == 200:
+                ok += 1
+                # whichever replica answered, the tenant's greedy output is
+                # bit-identical to the single-process LoRA reference
+                assert np.array_equal(body["tokens"], refs[i]), (i, body)
+            else:
+                assert body.get("type"), body  # failed TYPED, never silent
+        assert ok >= len(results) - 4  # zero-token retries recover the rest
+        killed = [rp for rp in procs if not rp.alive()]
+        assert len(killed) == 1  # the fault killed exactly one replica
+
+        # the survivor's /healthz advertises its resident tenants; the
+        # router snapshot carries them and adapter-aware pick() scores them
+        router.stop()
+        router.probe_once()
+        survivor = next(r for r in reps if r.process.alive())
+        resident = set(survivor.snapshot()["lora_adapters"])
+        assert resident & set(adapters), resident
+        target = sorted(resident & set(adapters))[0]
+        assert router.pick(adapter=target).rid == survivor.rid
+
+        # unknown tenant: typed 404 straight through the router — the
+        # retriable=false field stops the failover loop (no retry storm)
+        p = _prompt(6, seed=55)
+        status, body, _ = router.handle_generate(
+            {"input_ids": p.tolist(), "max_new_tokens": 2, "adapter": "ghost"}
+        )
+        assert status == 404
+        assert body["type"] == "AdapterUnknown"
+        assert body["retriable"] is False
+
+        # after the drill a known tenant still answers bit-identically
+        p0 = _prompt(6, seed=1000)
+        status, body, _ = router.handle_generate(
+            {"input_ids": p0.tolist(), "max_new_tokens": 4,
+             "adapter": adapters[0]}
+        )
+        assert status == 200
+        assert np.array_equal(body["tokens"], refs[0])
+    finally:
+        router.stop()
+        for rp in procs:
+            rp.terminate()

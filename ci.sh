@@ -200,6 +200,20 @@ LORA_TESTS=(tests/test_lora_serving.py::test_mixed_cobatch_bit_identity_zero_rec
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python -m pytest "${LORA_TESTS[@]}" -q -m "not slow" -p no:cacheprovider
 
+echo "== fused paged-decode kernel smoke (ISSUE 13 acceptance subset) =="
+# both tiers: the fused Pallas kernel (CPU: interpret mode — the same
+# kernel code that compiles on TPU) is token-identical to the gather
+# oracle on mixed ragged traffic with zero recompiles, and the widened
+# dense-kernel gate keeps the retired fallback reasons ("seq not a
+# 128-multiple", "attn_mask given") at zero; fast mode runs that pair,
+# full mode the whole file (spec-verify window, LoRA co-batch, scratch
+# overruns, key-padding-mask grads, table-bounds invariant)
+FUSED_TESTS=(tests/test_fused_paged_attention.py::TestEngineFused::test_mixed_traffic_token_identity_zero_recompiles
+             "tests/test_fused_paged_attention.py::TestWidenedGate::test_non_128_multiple_takes_pallas")
+[ "$MODE" != "fast" ] && FUSED_TESTS=(tests/test_fused_paged_attention.py)
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python -m pytest "${FUSED_TESTS[@]}" -q -p no:cacheprovider
+
 echo "== serving fault drills (ISSUE 6 acceptance subset) =="
 # both tiers run the deterministic core of the serving fault domain: the
 # prefill-hang -> watchdog -> warm-restart drill (0 fresh compiles, bit-

@@ -509,6 +509,15 @@ define_flag(
     "a miss — a prompt shorter than n just drafts from lower orders)",
 )
 define_flag(
+    "FLAGS_serve_decode_kernel", "auto",
+    "paged engine: attention kernel for the paged decode/verify hot path — "
+    "'auto' (fused Pallas kernel reading the arena through the page tables "
+    "in-kernel when on TPU and the shape is eligible, else gather-then-"
+    "dense), 'fused' (require the fused kernel; engine construction fails "
+    "if it cannot run), or 'gather' (force the materialized-gather oracle "
+    "the fused kernel is parity-tested against)",
+)
+define_flag(
     "FLAGS_serve_lora_capacity", 8,
     "multi-tenant LoRA serving: resident-adapter slots in the paged adapter "
     "arena (slot 0 is the pinned base-model passthrough on top of this).  "

@@ -120,7 +120,7 @@ from ..models.llama import (
     StaticKVCache,
 )
 from ..tensor import Tensor
-from .paging import PagePool, PrefixCache, spec_write_pages
+from .paging import PagePool, PrefixCache, check_table_bounds, spec_write_pages
 from .spec import NgramDrafter
 
 logger = logging.getLogger("paddle_tpu")
@@ -280,7 +280,8 @@ class ContinuousBatchingEngine:
 
     def __init__(self, model, slots=None, max_len=None, prefill_buckets=None,
                  queue_depth=None, seed=0, paged=None, page_size=None,
-                 pool_pages=None, prefix_cache=None, spec_k=None, lora=None):
+                 pool_pages=None, prefix_cache=None, spec_k=None, lora=None,
+                 decode_kernel=None):
         import jax
 
         from .. import jit, to_tensor
@@ -322,6 +323,27 @@ class ContinuousBatchingEngine:
             # default flag sane for tiny test engines
             self.page_size = max(1, min(ps, self.max_len))
             self.pages_per_seq = -(-self.max_len // self.page_size)
+            # paged-attention kernel selection (ISSUE 13): validated HERE so
+            # a forced-fused engine fails at construction, not mid-traffic
+            # inside a compiled step
+            dk = str(
+                _fcore.flag("FLAGS_serve_decode_kernel")
+                if decode_kernel is None else decode_kernel
+            )
+            if dk not in ("auto", "fused", "gather"):
+                raise ValueError(
+                    f"decode_kernel must be auto|fused|gather, got {dk!r}"
+                )
+            if dk == "fused":
+                head_ok = head_dim <= 256
+                page_ok = self.page_size % 8 == 0
+                if not (head_ok and page_ok):
+                    raise ValueError(
+                        "decode_kernel='fused' needs head_dim <= 256 and a "
+                        f"sublane-aligned page_size (8|ps); got head_dim="
+                        f"{head_dim}, page_size={self.page_size}"
+                    )
+            self.decode_kernel = dk
             pp = int(
                 pool_pages if pool_pages is not None
                 else _fcore.flag("FLAGS_serve_kv_pool_pages")
@@ -358,6 +380,7 @@ class ContinuousBatchingEngine:
             self._arenas = None
             self._pool = None
             self._prefix = None
+            self.decode_kernel = "auto"  # dense engines have no paged path
             self._caches = [
                 StaticKVCache(self.slots, self.max_len, cfg.num_key_value_heads,
                               head_dim, cache_dtype)
@@ -531,7 +554,10 @@ class ContinuousBatchingEngine:
         pos_eff = apply(
             lambda p, a: jnp.where(a, p, 0), [pos, active], name="serve_pos_mask"
         )
-        views = [PagedDecodeView(a, tables, self.max_len) for a in self._arenas]
+        views = [
+            PagedDecodeView(a, tables, self.max_len, kernel=self.decode_kernel)
+            for a in self._arenas
+        ]
         lora = self._lora.view(adapters) if self._lora is not None else None
         hidden, _ = self.model.llama(toks, caches=views, pos=pos_eff, lora=lora)
         logits = self.model.lm_head(hidden)[:, -1]  # [S, V]
@@ -585,7 +611,10 @@ class ContinuousBatchingEngine:
         pos_eff = apply(
             lambda p, a: jnp.where(a, p, 0), [pos, active], name="serve_pos_mask"
         )
-        views = [PagedDecodeView(a, tables, self.max_len) for a in self._arenas]
+        views = [
+            PagedDecodeView(a, tables, self.max_len, kernel=self.decode_kernel)
+            for a in self._arenas
+        ]
         lora = self._lora.view(adapters) if self._lora is not None else None
         hidden, _ = self.model.llama(toks, caches=views, pos=pos_eff, lora=lora)
         logits = self.model.lm_head(hidden)  # [S, k+1, V]
@@ -637,7 +666,8 @@ class ContinuousBatchingEngine:
         from ..ops.dispatch import apply
 
         views = [
-            PagedPrefillView(a, row_table, true_len, self.max_len)
+            PagedPrefillView(a, row_table, true_len, self.max_len,
+                             kernel=self.decode_kernel)
             for a in self._arenas
         ]
         lora = self._lora.view(adapters) if self._lora is not None else None
@@ -679,7 +709,8 @@ class ContinuousBatchingEngine:
         from ..ops.dispatch import apply
 
         views = [
-            PagedPrefillView(a, row_table, true_len, self.max_len, start=start)
+            PagedPrefillView(a, row_table, true_len, self.max_len, start=start,
+                             kernel=self.decode_kernel)
             for a in self._arenas
         ]
         lora = self._lora.view(adapters) if self._lora is not None else None
@@ -2224,6 +2255,7 @@ class ContinuousBatchingEngine:
         nothing, and an occupied slot's table covers every position it has
         written.  Caller holds _mu."""
         pool, ps = self._pool, self.page_size
+        check_table_bounds(self._page_table, pool.num_pages)
         expected = np.zeros(pool.num_pages, np.int64)
         expected[0] = 1  # scratch pin
         for s in range(self.slots):

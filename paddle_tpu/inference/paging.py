@@ -61,6 +61,25 @@ def spec_write_pages(pos, width, page_size, mapped_entries):
     return in_table, overrun
 
 
+def check_table_bounds(table, num_pages):
+    """Every page-table entry must name a real arena page: the fused paged
+    Pallas kernel indexes the arena by the RAW table value inside its
+    BlockSpec index maps (no clamp — a clamp would hide corruption as a
+    silent wrong-page read), so an out-of-range entry is device-undefined
+    behavior, not just a wrong answer.  Raises AssertionError on violation.
+    Pure host arithmetic; `table` is the host mirror ([..., P] int array)."""
+    t = np.asarray(table)
+    if t.size == 0:
+        return
+    lo, hi = int(t.min()), int(t.max())
+    if lo < 0 or hi >= int(num_pages):
+        bad = np.argwhere((t < 0) | (t >= int(num_pages)))
+        raise AssertionError(
+            f"page table entries out of arena bounds [0, {int(num_pages)}): "
+            f"min={lo}, max={hi}, first bad index={bad[0].tolist()}"
+        )
+
+
 class PagePool:
     """Refcounted page allocator.  Page 0 is scratch: pinned, never handed
     out, the target of every redirected garbage write."""

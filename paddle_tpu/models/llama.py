@@ -232,12 +232,14 @@ class PagedPrefillView:
     through a table gather.  Rows past `true_len` (bucket padding) and rows
     whose page index overruns the table are redirected to scratch page 0."""
 
-    def __init__(self, arena, table, true_len, max_len, start=None):
+    def __init__(self, arena, table, true_len, max_len, start=None,
+                 kernel="auto"):
         self.arena = arena
         self.table = table
         self.true_len = true_len
         self.max_len = max_len
         self.start = start
+        self.kernel = kernel  # paged attention dispatch: auto|fused|gather
 
 
 class PagedDecodeView:
@@ -253,10 +255,11 @@ class PagedDecodeView:
     positions j <= pos+i through the per-row-pos decode kernel.  Row 0 of
     a k+1 window is therefore the exact single-token decode step."""
 
-    def __init__(self, arena, tables, max_len):
+    def __init__(self, arena, tables, max_len, kernel="auto"):
         self.arena = arena
         self.tables = tables
         self.max_len = max_len
+        self.kernel = kernel  # paged attention dispatch: auto|fused|gather
 
 
 def _page_scatter(arena_t, new_t, table_t, true_len_t, start_t=None):
@@ -283,6 +286,56 @@ def _page_scatter(arena_t, new_t, table_t, true_len_t, start_t=None):
 
     ins = [arena_t, new_t, table_t, true_len_t] + ([start_t] if start_t is not None else [])
     return apply(f, ins, name="kv_page_scatter")
+
+
+def _rope_page_scatter(arena_k_t, arena_v_t, q, k, v, cos, sin, table_t,
+                       true_len_t, start_t=None):
+    """Fused prefill cache-write: RoPE on q/k AND the k/v page scatters in
+    ONE traced op — the unfused form round-trips the rotated k (and raw v)
+    through HBM between the rope op and each scatter op; fusing them keeps
+    the activations in registers/VMEM within one XLA computation.  The math
+    is operation-for-operation identical to `apply_rotary_pos_emb` (static
+    offset 0 without `start_t`, the per-row cos/sin gather with it) followed
+    by two `_page_scatter`s, so outputs stay bit-identical to the unfused
+    executables.  Returns (q_rot, k_rot, new_arena_k, new_arena_v)."""
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import apply
+
+    ps = arena_k_t.shape[1]
+    s = q.shape[1]
+
+    def f(ak, av, qa, ka, va, c, si, t, tl, *st):
+        if st:
+            # start is int32[1]: the same per-row cos/sin gather the rope op
+            # takes for a 1-d offset (jax gather clamps out-of-range)
+            idx = st[0][:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+            cc = c[idx][:, :, None, :].astype(qa.dtype)
+            si_ = si[idx][:, :, None, :].astype(qa.dtype)
+        else:
+            cc = c[0:s][None, :, None, :].astype(qa.dtype)
+            si_ = si[0:s][None, :, None, :].astype(qa.dtype)
+
+        def rot(x):
+            half = x.shape[-1] // 2
+            rh = jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+            return x * cc + rh * si_
+
+        q_rot, k_rot = rot(qa), rot(ka)
+        i = jnp.arange(s, dtype=jnp.int32)
+        gidx = (st[0][0] + i) if st else i
+        entry = gidx // ps
+        P = t.shape[0]
+        valid = (i < tl) & (entry < P)
+        pg = jnp.where(valid, t[jnp.minimum(entry, P - 1)], 0)
+        new_ak = ak.at[pg, gidx % ps].set(k_rot[0].astype(ak.dtype))
+        new_av = av.at[pg, gidx % ps].set(va[0].astype(av.dtype))
+        return q_rot, k_rot, new_ak, new_av
+
+    ins = [arena_k_t, arena_v_t, q, k, v, cos, sin, table_t, true_len_t]
+    if start_t is not None:
+        ins.append(start_t)
+    return apply(f, ins, multi=True, name="rope_page_scatter")
 
 
 def _page_decode_write(arena_t, new_t, tables_t, pos_t):
@@ -394,39 +447,39 @@ class LlamaAttention(nn.Layer):
                 # fresh paged prefill: identical math to the dense SlotView
                 # path (rope offset 0, causal SDPA over the prompt) — only
                 # WHERE the K/V rows land differs, so paged and dense
-                # engines produce bit-identical tokens
-                q, k = apply_rotary_pos_emb(q, k, self.rope_cos, self.rope_sin, 0)
-                cache.arena.k._data = _page_scatter(
-                    cache.arena.k, k, cache.table, cache.true_len
-                )._data
-                cache.arena.v._data = _page_scatter(
-                    cache.arena.v, v, cache.table, cache.true_len
-                )._data
+                # engines produce bit-identical tokens.  RoPE + both page
+                # scatters run as ONE fused op (no activation round-trip)
+                q, k, new_ak, new_av = _rope_page_scatter(
+                    cache.arena.k, cache.arena.v, q, k, v,
+                    self.rope_cos, self.rope_sin, cache.table, cache.true_len,
+                )
+                cache.arena.k._data = new_ak._data
+                cache.arena.v._data = new_av._data
                 out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
             else:
                 # chunk prefill (prefix-cache hit): suffix rows at rope
                 # offset `start` scatter into their pages, then attend the
                 # whole sequence — shared prefix included — through the
                 # table gather; row i sees j <= start + i
-                q, k = apply_rotary_pos_emb(
-                    q, k, self.rope_cos, self.rope_sin, cache.start
+                q, k, new_ak, new_av = _rope_page_scatter(
+                    cache.arena.k, cache.arena.v, q, k, v,
+                    self.rope_cos, self.rope_sin, cache.table, cache.true_len,
+                    cache.start,
                 )
-                cache.arena.k._data = _page_scatter(
-                    cache.arena.k, k, cache.table, cache.true_len, cache.start
-                )._data
-                cache.arena.v._data = _page_scatter(
-                    cache.arena.v, v, cache.table, cache.true_len, cache.start
-                )._data
+                cache.arena.k._data = new_ak._data
+                cache.arena.v._data = new_av._data
                 out = F.paged_flash_decode(
                     q, cache.arena.k, cache.arena.v,
                     cache.table.reshape([1, -1]), cache.start, cache.max_len,
+                    kernel=getattr(cache, "kernel", "auto"),
                 )
             out = out.reshape([b, s, self.num_heads * self.head_dim])
             return _lora_add(lora, "o_proj", self.o_proj(out), out), cache
         if isinstance(cache, PagedDecodeView):
             # paged compiled decode: same per-row rope and attended geometry
-            # as the dense StaticKVCache path; the gather through the page
-            # table happens inside the compiled step (tables are data)
+            # as the dense StaticKVCache path; the page-table indirection
+            # happens inside the compiled step (tables are data) — fused
+            # in-kernel on the Pallas path, gather-then-dense otherwise
             q, k = apply_rotary_pos_emb(q, k, self.rope_cos, self.rope_sin, pos)
             cache.arena.k._data = _page_decode_write(
                 cache.arena.k, k, cache.tables, pos
@@ -435,7 +488,8 @@ class LlamaAttention(nn.Layer):
                 cache.arena.v, v, cache.tables, pos
             )._data
             out = F.paged_flash_decode(
-                q, cache.arena.k, cache.arena.v, cache.tables, pos, cache.max_len
+                q, cache.arena.k, cache.arena.v, cache.tables, pos,
+                cache.max_len, kernel=getattr(cache, "kernel", "auto"),
             )
             out = out.reshape([b, s, self.num_heads * self.head_dim])
             return _lora_add(lora, "o_proj", self.o_proj(out), out), cache

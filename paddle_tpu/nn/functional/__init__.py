@@ -1356,15 +1356,19 @@ def flash_decode(query, key, value, pos, scale=None):
     return _fd(query, key, value, pos, scale)
 
 
-def paged_flash_decode(query, arena_k, arena_v, tables, pos, max_len, scale=None):
+def paged_flash_decode(query, arena_k, arena_v, tables, pos, max_len, scale=None,
+                       kernel="auto"):
     """Cached attention over a block-paged KV pool: q [b, sq, h, d] against
     per-layer arenas [num_pages, page_size, kv_h, d], addressed through
-    `tables` ([b, max_pages_per_seq] int32, traced data).  The page gather
-    happens inside the compiled step; validity comes from `pos` exactly as
-    in flash_decode, so paged and dense decode are bit-identical."""
+    `tables` ([b, max_pages_per_seq] int32, traced data).  The page
+    indirection happens inside the compiled step; validity comes from `pos`
+    exactly as in flash_decode, so paged and dense decode are bit-identical.
+    `kernel` selects the dispatch: "auto" (fused Pallas arena-reading kernel
+    when eligible, else gather-then-dense), "fused", or "gather"."""
     from ...ops.flash_attention import paged_flash_decode as _pfd
 
-    return _pfd(query, arena_k, arena_v, tables, pos, max_len, scale)
+    return _pfd(query, arena_k, arena_v, tables, pos, max_len, scale,
+                kernel=kernel)
 
 
 # ---------------------------------------------------------------------------

@@ -176,6 +176,13 @@ def dump(reason, path=None):
             lora = _prof.lora_summary()
             if lora:
                 header["lora"] = lora
+            # kernel dispatch at death: "was the hot path on the Pallas
+            # kernels or silently on the XLA fallback" — the perf
+            # post-mortem's first question
+            header["flash"] = {
+                "pallas": _prof.flash_pallas_summary(),
+                "fallbacks": _prof.flash_fallback_summary(),
+            }
         except Exception:
             pass
         with open(path, "w") as f:

@@ -39,7 +39,8 @@ Metric-name reference (the stable surface the scrape test pins):
     paddle_router_brownout_sheds_total / _deadline_sheds_total
     paddle_router_no_replica_total
     paddle_router_replica_state{replica=...,state=...} 1
-    paddle_flash_fallbacks_total{reason=...}
+    paddle_flash_fallbacks_total{reason=...}  (zero-filled label set)
+    paddle_flash_pallas_calls_total{kernel=...}  (zero-filled label set)
     paddle_sanitizer_<counter>_total  (traces, eager_misses, host_syncs,
         unexpected_traces, unexpected_eager, unexpected_syncs,
         allowed_events)
@@ -227,10 +228,29 @@ def render(labels=None):
                 "last observed state per replica (1 = current state)",
                 "gauge", {"replica": rid, "state": state})
 
-    for reason, n in sorted(snap["flash_fallbacks"].items()):
-        exp.add("paddle_flash_fallbacks_total", n,
+    # zero-filled label sets (like _FAULT_KINDS): a fallback regression must
+    # show as a counter MOVING on a dashboard, not as a series appearing —
+    # and the retired reasons' permanent zeros prove the gaps stay closed
+    try:
+        from ..ops import flash_attention as _fa
+        known_kernels = _fa._PALLAS_KERNELS
+        known_reasons = _fa._FALLBACK_REASONS
+    except Exception:
+        known_kernels = known_reasons = ()
+    fallbacks = dict(snap["flash_fallbacks"])
+    for reason in known_reasons:
+        fallbacks.setdefault(reason, 0)
+    for reason in sorted(fallbacks):
+        exp.add("paddle_flash_fallbacks_total", fallbacks[reason],
                 "flash-attention Pallas->XLA fallbacks by reason",
                 "counter", {"reason": reason})
+    pallas = dict(snap.get("flash_pallas", {}))
+    for kern in known_kernels:
+        pallas.setdefault(kern, 0)
+    for kern in sorted(pallas):
+        exp.add("paddle_flash_pallas_calls_total", pallas[kern],
+                "flash-attention Pallas kernel dispatches by kernel",
+                "counter", {"kernel": kern})
 
     try:
         from ..analysis import sanitizer as _san

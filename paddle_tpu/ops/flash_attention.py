@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import functools
 import math
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -64,7 +65,7 @@ def _blk_mask(s, q_start, k_start, block_q, block_k, causal, sq=None, sk=None):
 def _flash_fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     *, causal, scale, block_q, block_k, seg_refs=(), carry_refs=(),
-    off_ref=None,
+    off_ref=None, kb_ref=None,
 ):
     """Grid (bh blocks, q blocks, k blocks), k innermost: one K/V tile per
     step, (m, l, acc) carried in VMEM scratch across the sequential grid.
@@ -111,6 +112,11 @@ def _flash_fwd_kernel(
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
         ) * scale  # [bb, block_q, block_k]
+        if kb_ref is not None:
+            # additive key bias (lowered key-padding attn_mask): one value
+            # per key column, broadcast over the q rows exactly as the XLA
+            # fallback's `s + mask`
+            s = s + kb_ref[:, 0][None, None, :]
         sq = sk = None
         if seg_refs:
             sq = seg_refs[0][:, 0]
@@ -179,7 +185,8 @@ def _pick_bh_block(bh, n_heads, block_q, block_k, d, has_segments):
 
 def _pallas_flash_forward(q, k, v, causal, scale, segments=None, n_heads=1,
                           block_q=1024, block_k=1024, interpret=False,
-                          carry=None, out_dtype=None, q_offset=None):
+                          carry=None, out_dtype=None, q_offset=None,
+                          kbias=None):
     """q,k,v: [bh, seq, d]; segments: optional [b, seq, 1] int32 (shared
     across the head dim via the index map); carry: optional
     (out_prev [bh, seq, d], lse_prev [bh, seq, 1]) continuation state —
@@ -188,6 +195,8 @@ def _pallas_flash_forward(q, k, v, causal, scale, segments=None, n_heads=1,
     global start position of each q block, for rectangular causal blocks
     whose rows are not contiguous in global positions (zig-zag context
     parallelism); build with q_block_starts().
+    kbias: optional [b, k_len, 1] f32 additive per-key bias (a lowered
+    key-padding attn_mask), shared across heads via the index map.
     Returns (out [bh, seq, d], lse [bh, seq, 1] f32)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -198,7 +207,8 @@ def _pallas_flash_forward(q, k, v, causal, scale, segments=None, n_heads=1,
     # == 0, so 128 always works)
     block_q = _pick_block(seq_len, block_q)
     block_k = _pick_block(k_len, block_k)
-    bb = _pick_bh_block(bh, n_heads, block_q, block_k, d, segments is not None)
+    per_batch = segments is not None or kbias is not None
+    bb = _pick_bh_block(bh, n_heads, block_q, block_k, d, per_batch)
     grid = (bh // bb, seq_len // block_q, k_len // block_k)
 
     in_specs = [
@@ -214,6 +224,11 @@ def _pallas_flash_forward(q, k, v, causal, scale, segments=None, n_heads=1,
             pl.BlockSpec((None, block_k, 1), lambda b, i, j, *_: ((b * bb) // n_heads, j, 0)),
         ]
         args += [segments, segments]
+    if kbias is not None:
+        in_specs += [
+            pl.BlockSpec((None, block_k, 1), lambda b, i, j, *_: ((b * bb) // n_heads, j, 0)),
+        ]
+        args += [kbias]
     if carry is not None:
         in_specs += [
             pl.BlockSpec((bb, block_q, d), lambda b, i, j, *_: (b, i, 0)),
@@ -231,6 +246,10 @@ def _pallas_flash_forward(q, k, v, causal, scale, segments=None, n_heads=1,
             seg_refs, rest = rest[:2], rest[2:]
         else:
             seg_refs = ()
+        if kbias is not None:
+            kb_ref, rest = rest[0], rest[1:]
+        else:
+            kb_ref = None
         if carry is not None:
             carry_refs, rest = rest[:2], rest[2:]
         else:
@@ -240,6 +259,7 @@ def _pallas_flash_forward(q, k, v, causal, scale, segments=None, n_heads=1,
             q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             causal=causal, scale=scale, block_q=block_q, block_k=block_k,
             seg_refs=seg_refs, carry_refs=carry_refs, off_ref=off_ref,
+            kb_ref=kb_ref,
         )
 
     out_specs = [
@@ -283,7 +303,7 @@ def _pallas_flash_forward(q, k, v, causal, scale, segments=None, n_heads=1,
 def _flash_bwd_dkdv_kernel(
     q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_scr, dv_scr, *, causal, scale, block_q, block_k, seg_refs=(),
-    off_ref=None,
+    off_ref=None, kb_ref=None,
 ):
     """Grid (bh, k blocks, q blocks), q innermost; dk/dv accumulate in
     scratch across the q sweep."""
@@ -313,6 +333,8 @@ def _flash_bwd_dkdv_kernel(
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
         ) * scale  # [bb, bq, bk]
+        if kb_ref is not None:
+            s = s + kb_ref[:, 0][None, None, :]
         sq = sk = None
         if seg_refs:
             sq = seg_refs[0][:, 0]
@@ -340,6 +362,7 @@ def _flash_bwd_dkdv_kernel(
 def _flash_bwd_dq_kernel(
     q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref, dq_scr,
     *, causal, scale, block_q, block_k, seg_refs=(), off_ref=None,
+    kb_ref=None,
 ):
     """Grid (bh, q blocks, k blocks), k innermost; dq accumulates in
     scratch across the k sweep."""
@@ -368,6 +391,8 @@ def _flash_bwd_dq_kernel(
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
         ) * scale
+        if kb_ref is not None:
+            s = s + kb_ref[:, 0][None, None, :]
         sq = sk = None
         if seg_refs:
             sq = seg_refs[0][:, 0]
@@ -389,12 +414,14 @@ def _flash_bwd_dq_kernel(
 
 def _pallas_flash_backward(q, k, v, g, out, lse, causal, scale, segments=None,
                            n_heads=1, block_q=1024, block_k=1024, interpret=False,
-                           delta=None, q_offset=None):
+                           delta=None, q_offset=None, kbias=None):
     """q/g/out/lse: [bh, sq, ...]; k/v: [bh, sk, d] — rectangular k is
     allowed (causal with sq != sk requires q_offset: absolute per-q-block
     start positions; without q_offset, causal assumes sq == sk).
     delta: optional precomputed rowsum(g*out) [bh, sq, 1] — the ring path
     computes it ONCE for all hops instead of once per hop.
+    kbias: optional [b, sk, 1] f32 additive per-key bias (same operand as
+    the forward pass — s must be recomputed identically for p to match).
     Returns (dq, dk, dv)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -403,7 +430,8 @@ def _pallas_flash_backward(q, k, v, g, out, lse, causal, scale, segments=None,
     sk = k.shape[1]
     block_q = _pick_block(s, block_q)
     block_k = _pick_block(sk, block_k)
-    bb = _pick_bh_block(bh, n_heads, block_q, block_k, d, segments is not None)
+    per_batch = segments is not None or kbias is not None
+    bb = _pick_bh_block(bh, n_heads, block_q, block_k, d, per_batch)
     if delta is None:
         delta = jnp.sum(
             g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
@@ -427,6 +455,11 @@ def _pallas_flash_backward(q, k, v, g, out, lse, causal, scale, segments=None,
             pl.BlockSpec((None, block_k, 1), lambda b, i, j, *_: ((b * bb) // n_heads, i, 0)),
         ]
         args += [segments, segments]
+    if kbias is not None:
+        in_specs += [
+            pl.BlockSpec((None, block_k, 1), lambda b, i, j, *_: ((b * bb) // n_heads, i, 0)),
+        ]
+        args += [kbias]
 
     def dkdv_kernel(*refs):
         if q_offset is not None:
@@ -434,11 +467,16 @@ def _pallas_flash_backward(q, k, v, g, out, lse, causal, scale, segments=None,
         else:
             off_ref = None
         q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest = refs
-        seg_refs = rest[:2] if segments is not None else ()
+        if segments is not None:
+            seg_refs, rest = rest[:2], rest[2:]
+        else:
+            seg_refs = ()
+        kb_ref = rest[0] if kbias is not None else None
         dk_ref, dv_ref, dk_scr, dv_scr = rest[-4:]
         _flash_bwd_dkdv_kernel(
             q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-            dk_scr, dv_scr, seg_refs=seg_refs, off_ref=off_ref, **common,
+            dk_scr, dv_scr, seg_refs=seg_refs, off_ref=off_ref, kb_ref=kb_ref,
+            **common,
         )
 
     dkdv_grid = (bh // bb, sk // block_k, s // block_q)
@@ -492,6 +530,11 @@ def _pallas_flash_backward(q, k, v, g, out, lse, causal, scale, segments=None,
             pl.BlockSpec((None, block_k, 1), lambda b, i, j, *_: ((b * bb) // n_heads, j, 0)),
         ]
         args += [segments, segments]
+    if kbias is not None:
+        in_specs += [
+            pl.BlockSpec((None, block_k, 1), lambda b, i, j, *_: ((b * bb) // n_heads, j, 0)),
+        ]
+        args += [kbias]
 
     def dq_kernel(*refs):
         if q_offset is not None:
@@ -499,11 +542,15 @@ def _pallas_flash_backward(q, k, v, g, out, lse, causal, scale, segments=None,
         else:
             off_ref = None
         q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, *rest = refs
-        seg_refs = rest[:2] if segments is not None else ()
+        if segments is not None:
+            seg_refs, rest = rest[:2], rest[2:]
+        else:
+            seg_refs = ()
+        kb_ref = rest[0] if kbias is not None else None
         dq_ref, dq_scr = rest[-2:]
         _flash_bwd_dq_kernel(
             q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-            seg_refs=seg_refs, off_ref=off_ref, **common,
+            seg_refs=seg_refs, off_ref=off_ref, kb_ref=kb_ref, **common,
         )
 
     dq_grid = (bh // bb, s // block_q, sk // block_k)
@@ -696,8 +743,12 @@ def decode_attention_array(q, k, v, pos, scale=None):
         and sq >= 64
     ):
         # pad q rows up to the TPU sublane tile; padded rows attend slot 0+
-        # legitimately (their q_ids exceed the real rows') and are sliced off
+        # legitimately (their q_ids exceed the real rows') and are sliced off.
+        # The common serving shapes are already 8/128-aligned — hoist the
+        # check so they take a zero-copy path (no per-group pad OR slice)
         sq_pad = -(-sq // 8) * 8 if sq <= 256 else -(-sq // 128) * 128
+        needs_pad = sq_pad != sq
+        _log_pallas_call("decode")
         kf = kt.reshape(b * hk, L, d)
         vf = vt.reshape(b * hk, L, d)
         # one kernel call per GQA group: q heads of group r run against the
@@ -707,13 +758,12 @@ def decode_attention_array(q, k, v, pos, scale=None):
         outs = []
         for r in range(rep):
             qf = qg[:, :, r].reshape(b * hk, sq, d)
-            if sq_pad != sq:
+            if needs_pad:
                 qf = jnp.pad(qf, ((0, 0), (0, sq_pad - sq), (0, 0)))
-            outs.append(
-                _pallas_decode_forward(qf, kf, vf, pos, scale, interpret=interpret)[
-                    :, :sq
-                ].reshape(b, hk, 1, sq, d)
-            )
+            o = _pallas_decode_forward(qf, kf, vf, pos, scale, interpret=interpret)
+            if needs_pad:
+                o = o[:, :sq]
+            outs.append(o.reshape(b, hk, 1, sq, d))
         out = outs[0] if rep == 1 else jnp.concatenate(outs, axis=2)
         return jnp.transpose(out.reshape(b, h, sq, d), (0, 2, 1, 3))
     # dense path: grouped einsum chain (kv heads stay un-repeated; the GQA
@@ -760,24 +810,217 @@ def paged_gather_kv(arena, tables, max_len):
     return g[:, :max_len]
 
 
-def paged_decode_attention_array(q, arena_k, arena_v, tables, pos, max_len, scale=None):
-    """decode_attention_array over a block-paged KV pool: gather each
-    sequence's pages via its table row (inside the compiled step — tables
-    are data), then run the exact dense-cache decode math on the result.
-    Bit-identical to the dense path given bit-identical cache rows."""
+def _fused_paged_decode_forward(q, arena_k, arena_v, tables, pos, max_len,
+                                scale, interpret=False):
+    """Fused paged-decode attention: read the arena THROUGH the page tables
+    in-kernel instead of materializing the gather (`paged_gather_kv` writes
+    a dense [b, max_len, kv_h, d] copy of every sequence's KV to HBM each
+    step — the single biggest HBM tax on the serving hot path; ROADMAP 4).
+
+    q: [b, sq, h, d] (sq == 1 plain decode, sq == k+1 speculative verify);
+    arena_k/v: [num_pages, page_size, kv_h, d]; tables: [b, P] int32 page
+    ids (traced DATA — they index the arena inside the BlockSpec index
+    maps, fed as scalar-prefetch so the DMA engine knows each page before
+    its grid step); pos: int32 scalar or [b] per-slot positions.
+
+    Grid (slot, kv head, page) with the page dim innermost-sequential: one
+    [page_size, d] K/V tile streams through VMEM per step while online
+    softmax (m, l, acc) carries in scratch — the same recurrence as
+    `_flash_fwd_kernel`, but walking pages in table order.  Each slot's q
+    rows for one kv head pack the whole GQA group x verify window
+    ([rep * sq, d], row r = group member r // sq at window offset r % sq),
+    so the un-duplicated cache tile is read ONCE per group.  In-kernel
+    masks reproduce the gather path bit-for-bit: `jid <= pos + w` is the
+    per-row causal/validity fence (also inert for inactive slots parked on
+    scratch page 0 at pos 0) and `jid < max_len` reproduces the gather's
+    `[:max_len]` slice of the trailing page's slack rows.
+
+    Returns [b, sq, h, d]."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, h, d = q.shape
+    ps = arena_k.shape[1]
+    hk = arena_k.shape[2]
+    rep = h // hk
+    P = tables.shape[1]
+    R = rep * sq
+    qr = -(-R // 8) * 8  # f32 sublane tile; pad rows are sliced off
+    qt = jnp.transpose(q, (0, 2, 1, 3)).reshape(b, hk, rep, sq, d)
+    qg = qt.reshape(b, hk, R, d)
+    if qr != R:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, qr - R), (0, 0)))
+    pos_v = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (b,))
+    tab = jnp.asarray(tables, jnp.int32).reshape(-1)
+
+    def kernel(t_ref, p_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr):
+        j = pl.program_id(2)
+        n_p = pl.num_programs(2)
+        p0 = p_ref[pl.program_id(0)]
+
+        @pl.when(j == 0)
+        def _init():
+            m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+            l_scr[...] = jnp.zeros_like(l_scr)
+            acc_scr[...] = jnp.zeros_like(acc_scr)
+
+        # pages entirely beyond the newest visible position (window row
+        # sq-1 sees up to pos + sq - 1) contribute nothing
+        needed = j * ps <= p0 + sq - 1
+
+        @pl.when(needed)
+        def _compute():
+            qb = q_ref[...]  # [qr, d]
+            kb = k_ref[...]  # [ps, d] — the page this table entry names
+            vb = v_ref[...]
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [qr, ps]
+            w = jax.lax.broadcasted_iota(jnp.int32, (qr, ps), 0) % sq
+            jid = j * ps + jax.lax.broadcasted_iota(jnp.int32, (qr, ps), 1)
+            s = jnp.where((jid <= p0 + w) & (jid < max_len), s, _NEG_INF)
+            m = m_scr[..., 0]
+            l = l_scr[..., 0]
+            m_new = jnp.maximum(m, s.max(-1))
+            p = jnp.exp(s - m_new[..., None])
+            alpha = jnp.exp(m - m_new)
+            m_scr[...] = m_new[..., None]
+            l_scr[...] = (alpha * l + p.sum(-1))[..., None]
+            acc_scr[...] = acc_scr[...] * alpha[..., None] + jax.lax.dot_general(
+                p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        @pl.when(j == n_p - 1)
+        def _finish():
+            l_safe = jnp.maximum(l_scr[..., 0], 1e-30)
+            o_ref[...] = (acc_scr[...] / l_safe[..., None]).astype(o_ref.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hk, P),
+        in_specs=[
+            pl.BlockSpec((None, None, qr, d), lambda s, g, j, t, p: (s, g, 0, 0)),
+            pl.BlockSpec(
+                (None, ps, None, d), lambda s, g, j, t, p: (t[s * P + j], 0, g, 0)
+            ),
+            pl.BlockSpec(
+                (None, ps, None, d), lambda s, g, j, t, p: (t[s * P + j], 0, g, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (None, None, qr, d), lambda s, g, j, t, p: (s, g, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((qr, 1), jnp.float32),
+            pltpu.VMEM((qr, 1), jnp.float32),
+            pltpu.VMEM((qr, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hk, qr, d), q.dtype),
+        interpret=interpret,
+    )(tab, pos_v, qg, arena_k, arena_v)
+    out = out[:, :, :R].reshape(b, hk, rep, sq, d).reshape(b, h, sq, d)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _fused_paged_decode(q, arena_k, arena_v, tables, pos, max_len, scale,
+                        interpret):
+    """Differentiation-opaque wrapper: the dispatch layer's eager path
+    computes a vjp over every op, and scalar-prefetch pallas_call has no JVP
+    rule — decode is inference-only, so the vjp is declared (never pulled)
+    via custom_vjp instead of traced through the kernel."""
+    return _fused_paged_decode_forward(
+        q, arena_k, arena_v, tables, pos, max_len, scale, interpret=interpret
+    )
+
+
+def _fused_paged_decode_fwd(q, arena_k, arena_v, tables, pos, max_len, scale,
+                            interpret):
+    out = _fused_paged_decode_forward(
+        q, arena_k, arena_v, tables, pos, max_len, scale, interpret=interpret
+    )
+    return out, None
+
+
+def _fused_paged_decode_bwd(max_len, scale, interpret, res, g):
+    raise NotImplementedError(
+        "fused paged decode attention is inference-only (no backward); "
+        "differentiate through kernel='gather' instead"
+    )
+
+
+_fused_paged_decode.defvjp(_fused_paged_decode_fwd, _fused_paged_decode_bwd)
+
+
+def _fused_paged_viable(q, page_size):
+    """Static eligibility for the fused paged kernel.  The arena page IS
+    the kernel's K/V block, so page_size must be a sublane multiple; head
+    dim is bounded by the same VMEM budget as the dense kernels."""
+    if q.shape[3] > 256:
+        return False, "paged head_dim > 256"
+    if page_size % 8 != 0:
+        return False, "paged page_size not 8-aligned"
+    return True, None
+
+
+def paged_decode_attention_array(q, arena_k, arena_v, tables, pos, max_len,
+                                 scale=None, kernel="auto"):
+    """Paged-decode attention dispatcher.
+
+    kernel="auto": the fused Pallas kernel when on TPU (or under interpret)
+    and the shape is eligible, else gather-then-dense.  kernel="fused":
+    require the fused kernel — raises ValueError when it cannot run (the
+    engine surfaces this at construction, not mid-traffic).
+    kernel="gather": force the gather-then-dense oracle (`paged_gather_kv`
+    materializes each sequence's KV densely, then the exact dense-cache
+    decode math runs on the result) — the bit-parity baseline the fused
+    kernel is tested against.  Both paths are bit-identical to the dense
+    slot pool given bit-identical cache rows."""
+    if kernel not in ("auto", "fused", "gather"):
+        raise ValueError(
+            f"paged decode kernel must be auto|fused|gather, got {kernel!r}"
+        )
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    interpret = _FORCE_INTERPRET
+    if kernel != "gather":
+        ok, reason = _fused_paged_viable(q, arena_k.shape[1])
+        on_path = _on_tpu() or interpret
+        if ok and on_path:
+            _log_pallas_call("paged_decode_fused")
+            return _fused_paged_decode(
+                q, arena_k, arena_v, tables, pos, max_len, scale, interpret
+            )
+        if kernel == "fused":
+            raise ValueError(
+                "paged decode kernel 'fused' unavailable: "
+                + (reason or "not on TPU (tests set _FORCE_INTERPRET)")
+            )
+        if on_path:
+            _log_pallas_fallback(reason, shape=q.shape)
     k = paged_gather_kv(arena_k, tables, max_len)
     v = paged_gather_kv(arena_v, tables, max_len)
     return decode_attention_array(q, k, v, pos, scale)
 
 
-def paged_flash_decode(query, arena_k, arena_v, tables, pos, max_len, scale=None):
+def paged_flash_decode(query, arena_k, arena_v, tables, pos, max_len, scale=None,
+                       kernel="auto"):
     """Tensor-level paged cached-decode attention."""
     query, arena_k, arena_v = coerce(query), coerce(arena_k), coerce(arena_v)
     tables, pos = coerce(tables), coerce(pos)
     max_len = int(max_len)
+    kernel = str(kernel)
 
     def f(q, ak, av, t, p):
-        return paged_decode_attention_array(q, ak, av, t, p, max_len, scale)
+        return paged_decode_attention_array(
+            q, ak, av, t, p, max_len, scale, kernel=kernel
+        )
 
     return apply(f, [query, arena_k, arena_v, tables, pos], name="paged_flash_decode")
 
@@ -905,7 +1148,34 @@ def _flash_backward(q, k, v, mask, out, lse, g, causal, scale, block_k=512):
 # public entry — jax-level (arrays in, arrays out; custom_vjp around pallas)
 # ---------------------------------------------------------------------------
 
+# Every Pallas kernel this module can dispatch, and every fallback reason it
+# can emit — obs/metrics.py zero-renders both families so a fallback
+# regression shows up as a counter MOVING, not a series appearing.  The two
+# retired reasons ("seq not a 128-multiple", "attn_mask given") stay listed:
+# their permanent zeros are the proof the gaps are closed.
+_PALLAS_KERNELS = ("flash_fwd", "flash_bwd", "decode", "paged_decode_fused")
+_FALLBACK_REASONS = (
+    "attn_mask not key-padding",
+    "q/k shapes differ",
+    "head_dim > 256",
+    "paged head_dim > 256",
+    "paged page_size not 8-aligned",
+    "seq not a 128-multiple",  # retired (pad-and-mask) — must stay 0
+    "attn_mask given",         # retired (key-bias lowering) — must stay 0
+)
+
+_fallback_lock = threading.Lock()
 _fallback_logged = set()  # (reason, shape) pairs already warned about
+_FALLBACK_LOG_BOUND = 512  # serving emits few distinct shapes; cap leaks
+
+
+def _log_pallas_call(kernel):
+    """Count a Pallas kernel dispatch (the positive counterpart to
+    `_log_pallas_fallback`): benches and /metrics prove the fast path ran
+    by this counter moving, not by the absence of fallbacks."""
+    from .. import profiler as _prof
+
+    _prof.record_flash_pallas_call(kernel)
 
 
 def _log_pallas_fallback(reason, shape=None):
@@ -918,7 +1188,20 @@ def _log_pallas_fallback(reason, shape=None):
 
     _prof.record_flash_fallback(reason)
     key = (reason, tuple(shape) if shape is not None else None)
-    if key not in _fallback_logged:
+    warn = False
+    global _fallback_logged
+    with _fallback_lock:
+        if not isinstance(_fallback_logged, set):
+            # tests plant falsy sentinels here to detect logging; keep their
+            # `assert not fa._fallback_logged` semantics by replacing the
+            # sentinel with a real (truthy) set instead of crashing
+            _fallback_logged = set()
+        if key not in _fallback_logged:
+            if len(_fallback_logged) >= _FALLBACK_LOG_BOUND:
+                _fallback_logged.clear()
+            _fallback_logged.add(key)
+            warn = True
+    if warn:
         import logging
 
         logging.getLogger("paddle_tpu").warning(
@@ -926,21 +1209,69 @@ def _log_pallas_fallback(reason, shape=None):
             "using XLA blockwise fallback",
             reason, key[1],
         )
-        _fallback_logged.add(key)
 
 
 # tests set this to exercise the Pallas kernels off-TPU via interpret mode
 _FORCE_INTERPRET = False
 
 
-def _pallas_viable(q, k, mask):
-    s, d = q.shape[2], q.shape[3]
-    if mask is not None:
-        return False, "attn_mask given"
-    if s % 128 != 0 or q.shape != k.shape:
-        return False, f"seq {s} not a 128-multiple or q/k shapes differ"
+def _key_padding_bias(mask, b, sk):
+    """If `mask` is a plain key-padding mask — additive, broadcast over the
+    q rows and heads, i.e. shape [mb, 1, 1, sk] with mb in {1, b} — lower it
+    to a [b, sk] f32 per-key bias the Pallas kernels add in-kernel.  Any
+    other mask geometry returns None (those stay on the XLA fallback)."""
+    if mask is None:
+        return None
+    if mask.ndim != 4 or mask.shape[1] != 1 or mask.shape[2] != 1:
+        return None
+    mb = mask.shape[0]
+    if mb not in (1, b) or mask.shape[3] != sk:
+        return None
+    return jnp.broadcast_to(
+        mask.reshape(mb, sk).astype(jnp.float32), (b, sk)
+    )
+
+
+def _pad_flash_inputs(q, k, v, segments, kbias):
+    """Pad the sequence dim of [b,h,s,d] q/k/v up to the next 128 multiple
+    so the Pallas kernels' block geometry holds on ragged serving shapes.
+    Padded positions MUST be fenced or they poison real rows' softmax
+    denominators (a zero-key column scores 0, not -inf) — so the pad path
+    always carries segment ids: real positions keep their ids (or 0 when
+    the caller had none), pad positions get -1 and are masked against
+    everything real.  kbias pads with 0 (pad columns are already fenced by
+    the segment ids).  Returns (q, k, v, segments, kbias, s_pad)."""
+    b, h, s, d = q.shape
+    s_pad = -(-s // 128) * 128
+    if s_pad == s:
+        return q, k, v, segments, kbias, s
+    pad = s_pad - s
+    q = jnp.pad(q, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    if segments is None:
+        segments = jnp.zeros((b, s), jnp.int32)
+    segments = jnp.pad(
+        jnp.asarray(segments, jnp.int32), ((0, 0), (0, pad)),
+        constant_values=-1,
+    )
+    if kbias is not None:
+        kbias = jnp.pad(kbias, ((0, 0), (0, pad)))
+    return q, k, v, segments, kbias, s_pad
+
+
+def _pallas_viable(q, k, mask, kbias):
+    """Static eligibility for the dense Pallas kernels.  Non-128-multiple
+    sequences are no longer refused (the wrapper pads and fences them) and
+    plain key-padding masks lower to an in-kernel bias — the remaining
+    reasons are structural."""
+    d = q.shape[3]
+    if mask is not None and kbias is None:
+        return False, "attn_mask not key-padding"
+    if q.shape != k.shape:
+        return False, "q/k shapes differ"
     if d > 256:
-        return False, f"head_dim {d} > 256"
+        return False, "head_dim > 256"
     return True, None
 
 
@@ -968,17 +1299,25 @@ def _flash_fwd_impl(q, k, v, mask, segments, causal, scale):
     b, h, s, d = q.shape
     interpret = _FORCE_INTERPRET
     if _on_tpu() or interpret:
-        ok, reason = _pallas_viable(q, k, mask)
+        kbias = _key_padding_bias(mask, b, k.shape[2])
+        ok, reason = _pallas_viable(q, k, mask, kbias)
         if ok:
-            qf = q.reshape(b * h, s, d)
-            kf = k.reshape(b * h, s, d)
-            vf = v.reshape(b * h, s, d)
-            segf = _seg_flat(segments, h) if segments is not None else None
+            qp, kp, vp, segp, kbp, s_pad = _pad_flash_inputs(
+                q, k, v, segments, kbias
+            )
+            _log_pallas_call("flash_fwd")
+            qf = qp.reshape(b * h, s_pad, d)
+            kf = kp.reshape(b * h, s_pad, d)
+            vf = vp.reshape(b * h, s_pad, d)
+            segf = _seg_flat(segp, h) if segp is not None else None
+            kbf = kbp[:, :, None] if kbp is not None else None
             out, lse = _pallas_flash_forward(
                 qf, kf, vf, causal, scale, segments=segf, n_heads=h,
-                interpret=interpret,
+                interpret=interpret, kbias=kbf,
             )
-            return out.reshape(b, h, s, d), lse.reshape(b, h, s), True
+            out = out.reshape(b, h, s_pad, d)[:, :, :s]
+            lse = lse.reshape(b, h, s_pad)[:, :, :s]
+            return out, lse, True
         _log_pallas_fallback(reason, shape=q.shape)
     if segments is not None:
         seg_mask = _segments_mask(segments, b, h)
@@ -996,24 +1335,39 @@ def _flash_bwd_rule(causal, scale, res, g):
     q, k, v, mask, segments, out, lse, used_pallas = res
     if used_pallas:
         b, h, s, d = q.shape
-        segf = _seg_flat(segments, h) if segments is not None else None
+        # reconstruct the forward's padded geometry deterministically; pad
+        # g/out/lse with zeros — a padded q row's p is either 0 (masked vs
+        # real keys) or hits g=0/delta=0, so it contributes exactly nothing
+        # to dk/dv, and its own dq row is sliced off
+        kbias = _key_padding_bias(mask, b, k.shape[2])
+        qp, kp, vp, segp, kbp, s_pad = _pad_flash_inputs(q, k, v, segments, kbias)
+        gp, outp, lsep = g, out, lse
+        if s_pad != s:
+            pad = s_pad - s
+            gp = jnp.pad(g, ((0, 0), (0, 0), (0, pad), (0, 0)))
+            outp = jnp.pad(out, ((0, 0), (0, 0), (0, pad), (0, 0)))
+            lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, pad)))
+        segf = _seg_flat(segp, h) if segp is not None else None
+        kbf = kbp[:, :, None] if kbp is not None else None
+        _log_pallas_call("flash_bwd")
         dq, dk, dv = _pallas_flash_backward(
-            q.reshape(b * h, s, d),
-            k.reshape(b * h, s, d),
-            v.reshape(b * h, s, d),
-            g.reshape(b * h, s, d),
-            out.reshape(b * h, s, d),
-            lse.reshape(b * h, s, 1),
+            qp.reshape(b * h, s_pad, d),
+            kp.reshape(b * h, s_pad, d),
+            vp.reshape(b * h, s_pad, d),
+            gp.reshape(b * h, s_pad, d),
+            outp.reshape(b * h, s_pad, d),
+            lsep.reshape(b * h, s_pad, 1),
             causal,
             scale,
             segments=segf,
             n_heads=h,
             interpret=_FORCE_INTERPRET,
+            kbias=kbf,
         )
         return (
-            dq.reshape(q.shape),
-            dk.reshape(k.shape),
-            dv.reshape(v.shape),
+            dq.reshape(b, h, s_pad, d)[:, :, :s],
+            dk.reshape(b, h, s_pad, d)[:, :, :s],
+            dv.reshape(b, h, s_pad, d)[:, :, :s],
             None,
             None,
         )

@@ -232,6 +232,7 @@ _paging_gauges = {
 }
 
 _flash_fallbacks = {}  # reason -> count of Pallas-ineligible compilations
+_flash_pallas = {}  # kernel -> count of Pallas kernel compilations dispatched
 
 
 def record_flash_fallback(reason):
@@ -251,6 +252,24 @@ def reset_flash_fallbacks():
         _flash_fallbacks.clear()
 
 
+def record_flash_pallas_call(kernel):
+    """One flash-attention dispatch that took a Pallas kernel (the positive
+    counterpart to record_flash_fallback): counted per compiled shape, keyed
+    by kernel name — benches prove the fast path ran by this moving."""
+    with _counters_lock:
+        _flash_pallas[kernel] = _flash_pallas.get(kernel, 0) + 1
+
+
+def flash_pallas_summary():
+    with _counters_lock:
+        return dict(_flash_pallas)
+
+
+def reset_flash_pallas():
+    with _counters_lock:
+        _flash_pallas.clear()
+
+
 def reset():
     """Zero EVERY counter family (step, serving, paging, router, flash
     fallbacks) in one critical section.  bench.py calls this between legs
@@ -265,6 +284,7 @@ def reset():
         _reset_lora_locked()
         _reset_router_locked()
         _flash_fallbacks.clear()
+        _flash_pallas.clear()
 
 
 def metrics_snapshot():
@@ -286,6 +306,7 @@ def metrics_snapshot():
             "lora": dict(_lora_gauges),
             "router": router,
             "flash_fallbacks": dict(_flash_fallbacks),
+            "flash_pallas": dict(_flash_pallas),
         }
 
 

@@ -41,6 +41,7 @@ _SANITIZED_MODULES = {
     "test_observability",
     "test_spec_decode",
     "test_lora_serving",
+    "test_fused_paged_attention",
 }
 
 

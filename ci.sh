@@ -214,6 +214,21 @@ FUSED_TESTS=(tests/test_fused_paged_attention.py::TestEngineFused::test_mixed_tr
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python -m pytest "${FUSED_TESTS[@]}" -q -p no:cacheprovider
 
+echo "== tensor-parallel smoke (ISSUE 14 acceptance subset) =="
+# both tiers, pinned to the 8-device CPU-sim mesh: the TP=4 engine (column/
+# row-sharded projections, mesh-sharded KV arena + decode kernel, all in the
+# one compiled step) decodes mixed paged/prefix/spec traffic token-identical
+# to TP=1 with the compiled budget frozen, and a bad model/tp pair fails at
+# construction with a typed ShardingError naming the axis; fast mode runs
+# that pair, full mode the whole file (warm-restart arena survival, LoRA
+# co-batch under TP, shard_map kernel vs the gather oracle, mesh obs spine)
+TP_TESTS=(tests/test_tp_serving.py::test_tp4_greedy_identical_on_mixed_traffic
+          tests/test_tp_serving.py::test_validate_tp_rejects_indivisible_kv_heads)
+[ "$MODE" != "fast" ] && TP_TESTS=(tests/test_tp_serving.py)
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest "${TP_TESTS[@]}" -q -p no:cacheprovider
+
 echo "== serving fault drills (ISSUE 6 acceptance subset) =="
 # both tiers run the deterministic core of the serving fault domain: the
 # prefill-hang -> watchdog -> warm-restart drill (0 fresh compiles, bit-

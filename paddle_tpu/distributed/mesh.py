@@ -49,6 +49,20 @@ def build_mesh(dp=1, mp=1, pp=1, sharding=1, sep=1, ep=1, devices=None):
     return _global_mesh
 
 
+def serving_mesh(tp, devices=None):
+    """Build + install an mp-only mesh over the FIRST `tp` devices for
+    tensor-parallel serving.  Passing an explicit device slice (rather than
+    letting leftover devices absorb into 'dp') keeps a TP=4 engine on an
+    8-device host from silently claiming a 2-wide data-parallel axis it
+    never uses."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if len(devs) < tp:
+        raise ValueError(
+            f"serving_mesh(tp={tp}) needs {tp} devices, found {len(devs)}"
+        )
+    return build_mesh(mp=tp, devices=devs[:tp])
+
+
 def set_mesh(mesh):
     global _global_mesh
     _global_mesh = mesh

@@ -34,6 +34,49 @@ from . import mesh as _mesh
 _AXIS = "sharding"
 
 
+class ShardingError(ValueError):
+    """A requested parallel layout cannot be realized on this model/mesh.
+
+    Raised at CONSTRUCTION time (engine/mesh build) with the offending axis
+    and degrees in the message, instead of letting GSPMD surface an opaque
+    shape-mismatch error deep inside the first trace."""
+
+
+def validate_tp(config, tp, devices=None):
+    """Typed construction-time check that `config` can run tensor-parallel
+    at degree `tp`: every sharded head axis must divide evenly (a ragged
+    head split would silently change the attention math, so GSPMD refuses
+    it — with an unreadable error) and enough devices must exist to build
+    the 'mp' mesh.  Divisibility is checked FIRST so a bad model/tp pair
+    fails identically on a laptop and on the pod."""
+    tp = int(tp)
+    if tp < 1:
+        raise ShardingError(f"tensor-parallel degree must be >= 1, got {tp}")
+    if tp == 1:
+        return
+    heads = int(config.num_attention_heads)
+    kv_heads = int(config.num_key_value_heads)
+    if heads % tp != 0:
+        raise ShardingError(
+            f"num_attention_heads ({heads}) is not divisible by the "
+            f"tensor-parallel degree ({tp}): the q_proj output axis cannot "
+            "split evenly over the 'mp' mesh axis"
+        )
+    if kv_heads % tp != 0:
+        raise ShardingError(
+            f"num_key_value_heads ({kv_heads}) is not divisible by the "
+            f"tensor-parallel degree ({tp}): the KV arena kv_heads axis "
+            "cannot split evenly over the 'mp' mesh axis"
+        )
+    n = len(list(devices) if devices is not None else jax.devices())
+    if n < tp:
+        raise ShardingError(
+            f"tensor-parallel degree {tp} needs {tp} devices on the 'mp' "
+            f"mesh axis but only {n} are present (CPU tier: run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+        )
+
+
 def _spec_for(shape, n, axis=_AXIS):
     """Shard the first dim when divisible; replicate otherwise (the
     reference shards flattened param groups; we keep param shapes and skip

@@ -518,6 +518,16 @@ define_flag(
     "the fused kernel is parity-tested against)",
 )
 define_flag(
+    "FLAGS_serve_tp", 1,
+    "tensor-parallel serving: shard the model's column/row-parallel "
+    "projections, the paged KV arena (kv_heads axis), and the fused "
+    "paged-decode kernel across the first N devices of an 'mp' mesh built "
+    "at engine construction.  All per-slot scheduling state stays host-side "
+    "and replicated, so the compiled budget and zero-recompile contract are "
+    "unchanged; heads/kv_heads must divide by N (typed ShardingError "
+    "otherwise).  1 disables (single-device engine, no mesh installed)",
+)
+define_flag(
     "FLAGS_serve_lora_capacity", 8,
     "multi-tenant LoRA serving: resident-adapter slots in the paged adapter "
     "arena (slot 0 is the pinned base-model passthrough on top of this).  "

@@ -120,7 +120,13 @@ from ..models.llama import (
     StaticKVCache,
 )
 from ..tensor import Tensor
-from .paging import PagePool, PrefixCache, check_table_bounds, spec_write_pages
+from .paging import (
+    PagePool,
+    PrefixCache,
+    check_table_bounds,
+    shard_kv_for_tp,
+    spec_write_pages,
+)
 from .spec import NgramDrafter
 
 logger = logging.getLogger("paddle_tpu")
@@ -281,7 +287,7 @@ class ContinuousBatchingEngine:
     def __init__(self, model, slots=None, max_len=None, prefill_buckets=None,
                  queue_depth=None, seed=0, paged=None, page_size=None,
                  pool_pages=None, prefix_cache=None, spec_k=None, lora=None,
-                 decode_kernel=None):
+                 decode_kernel=None, tp=None):
         import jax
 
         from .. import jit, to_tensor
@@ -308,6 +314,42 @@ class ContinuousBatchingEngine:
         # executables (they outlive any later train() switch)
         if getattr(model, "training", False):
             model.eval()
+
+        # tensor-parallel serving (ISSUE 14): validate + install the 'mp'
+        # mesh and re-place the weights BEFORE any cache/arena below is
+        # allocated, so every serving buffer is born with its mesh layout.
+        # All per-slot scheduling state stays host-side and replicated —
+        # the compiled budget and zero-recompile contract are unchanged.
+        from .. import profiler as _prof
+        from ..distributed import mesh as _mesh_mod
+        from ..distributed.sharding import ShardingError, validate_tp
+
+        self.tp = int(_fcore.flag("FLAGS_serve_tp") if tp is None else tp)
+        validate_tp(cfg, self.tp)
+        self._mesh = None
+        if self.tp > 1:
+            if int(getattr(cfg, "tensor_parallel_degree", 1)) != self.tp:
+                raise ShardingError(
+                    f"engine tp={self.tp} but the model was built with "
+                    f"tensor_parallel_degree={cfg.tensor_parallel_degree}: "
+                    "construct the model with LlamaConfig(tensor_parallel_"
+                    f"degree={self.tp}) so its projections are the column/"
+                    "row-parallel layers the mesh shards"
+                )
+            from ..models.llama import shard_llama_for_tp
+
+            self._mesh = _mesh_mod.serving_mesh(self.tp)
+            shard_llama_for_tp(model)
+        # per compiled step at TP>1, GSPMD inserts one allreduce per
+        # row-parallel output (o_proj + down_proj per layer) plus one for
+        # the vocab-sharded logits' sampling reduction
+        _prof.record_mesh_topology(
+            devices=len(jax.devices()),
+            tp=self.tp,
+            allreduce_per_step=(
+                2 * cfg.num_hidden_layers + 1 if self.tp > 1 else 0
+            ),
+        )
 
         head_dim = cfg.hidden_size // cfg.num_attention_heads
         cache_dtype = model.lm_head.weight.dtype  # bf16 under AMP-O2 decorate
@@ -357,6 +399,9 @@ class ContinuousBatchingEngine:
                              cfg.num_key_value_heads, head_dim, cache_dtype)
                 for _ in range(cfg.num_hidden_layers)
             ]
+            if self.tp > 1:
+                for a in self._arenas:
+                    shard_kv_for_tp(a)
             self._pool = PagePool(self.pool_pages)
             use_prefix = bool(
                 _fcore.flag("FLAGS_serve_prefix_cache")
@@ -386,6 +431,9 @@ class ContinuousBatchingEngine:
                               head_dim, cache_dtype)
                 for _ in range(cfg.num_hidden_layers)
             ]
+            if self.tp > 1:
+                for c in self._caches:
+                    shard_kv_for_tp(c)
             self._decode_fn = jit.to_static(self._decode_body)
             self._prefill_fn = jit.to_static(self._prefill_body)
         # multi-tenant LoRA (ISSUE 12): an AdapterArena whose per-slot ids
@@ -394,6 +442,8 @@ class ContinuousBatchingEngine:
         if lora is not None and not self.paged:
             raise ValueError("LoRA serving requires the paged engine")
         self._lora = lora
+        if lora is not None and self.tp > 1:
+            lora.shard_for_tp()
         # arena slot bound per ENGINE slot (0 = base model); mirrors
         # _page_table's lifecycle: set at slot landing, cleared at recycle
         self._slot_adapter = np.zeros(self.slots, np.int32)
@@ -1054,6 +1104,13 @@ class ContinuousBatchingEngine:
             # speculation is accepting drafts) — the factor decode_ewma_ms
             # must be divided by when comparing replica throughput
             "tokens_per_step": round(self._tok_rate_ewma, 3),
+            # mesh topology (ISSUE 14): degree + axis shape so a fleet
+            # operator can see which replicas are TP-sharded from /healthz
+            "tp": self.tp,
+            "mesh_shape": (
+                {a: int(s) for a, s in self._mesh.shape.items() if int(s) > 1}
+                if self._mesh is not None else {}
+            ),
         }
         if self._lora is not None:
             # adapter residency for the router: a replica already holding a

@@ -61,6 +61,32 @@ def spec_write_pages(pos, width, page_size, mapped_entries):
     return in_table, overrun
 
 
+# Canonical tensor-parallel layout of every KV cache buffer (ISSUE 14):
+# paged arenas are [num_pages, page_size, kv_heads, head_dim] and dense slot
+# pools are [slots, max_len, kv_heads, head_dim] — both split the KV HEADS
+# axis (dim 2) over the 'mp' mesh axis, so each device stores and streams
+# only its local heads' rows.  Page identity, table entries, and every piece
+# of host-side bookkeeping in this module stay device-count-agnostic: a page
+# is the SAME page on every shard, just narrower.
+KV_TP_AXIS = 2
+
+
+def shard_kv_for_tp(cache):
+    """Place a KV cache's k/v buffers on the installed 'mp' mesh, sharded on
+    the kv_heads axis (see KV_TP_AXIS).  No-op without a TP mesh, so the
+    engine calls it unconditionally; returns the cache for chaining."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..distributed import mesh as _mesh
+
+    if _mesh.get_mesh() is None or _mesh.axis_size("mp") <= 1:
+        return cache
+    spec = P(None, None, "mp", None)
+    _mesh.shard_tensor_(cache.k, spec)
+    _mesh.shard_tensor_(cache.v, spec)
+    return cache
+
+
 def check_table_bounds(table, num_pages):
     """Every page-table entry must name a real arena page: the fused paged
     Pallas kernel indexes the arena by the RAW table value inside its

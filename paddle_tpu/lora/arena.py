@@ -35,6 +35,11 @@ from ..tensor import Tensor
 from .registry import TARGETS, target_dims
 
 
+# row-parallel projections: their LoRA input arrives 'mp'-sharded, so A
+# shards on d_in; every other target is column-parallel and B shards on d_out
+_ROW_TARGETS = ("o_proj", "down_proj")
+
+
 class AdapterArenaFull(RuntimeError):
     """Every arena slot is bound to an in-flight request — the load must
     wait for a decode to finish.  Admission parks the request (retriable
@@ -139,6 +144,33 @@ class AdapterArena:
 
     def view(self, ids):
         return ArenaView(self, ids)
+
+    def shard_for_tp(self):
+        """Re-place the adapter stacks on the installed 'mp' mesh so the
+        batched-gather delta composes with the tensor-parallel projections:
+        column targets (q/k/v/gate/up) shard B on d_out — the delta lands
+        already split like the base projection's output — while row targets
+        (o_proj/down_proj) shard A on d_in, matching their 'mp'-sharded
+        input, and GSPMD folds the contraction's partial sums into the same
+        allreduce the row-parallel output already takes.  A-of-column /
+        B-of-row and the scale vector replicate (they touch no sharded
+        axis).  In-place upload writes (`_data.at[slot].set`) preserve the
+        placement, so adapter churn keeps zero retraces at TP>1 too."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..distributed import mesh as _mesh
+
+        if _mesh.get_mesh() is None or _mesh.axis_size("mp") <= 1:
+            return
+        with self._mu:
+            for (_, t), (A, B) in self._stacks.items():
+                if t in _ROW_TARGETS:
+                    _mesh.shard_tensor_(A, P(None, "mp", None))
+                    _mesh.shard_tensor_(B, P())
+                else:
+                    _mesh.shard_tensor_(A, P())
+                    _mesh.shard_tensor_(B, P(None, None, "mp"))
+            _mesh.shard_tensor_(self._scale, P())
 
     # -- residency ----------------------------------------------------------
 

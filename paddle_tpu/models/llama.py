@@ -683,3 +683,50 @@ class LlamaForCausalLM(nn.Layer):
             decode_strategy=decode_strategy, num_beams=num_beams, seed=seed,
             eos_token_id=eos_token_id, length_penalty=length_penalty,
         )
+
+
+def shard_llama_for_tp(model):
+    """Re-place an already-constructed TP Llama's weights onto the installed
+    'mp' mesh.  The parallel layers shard themselves at construction, but a
+    serving model is usually built BEFORE the engine installs its mesh (so
+    those `shard_tensor_` calls were no-ops); this walks the module tree and
+    applies the canonical layout eagerly:
+
+      ColumnParallelLinear   weight P(None, 'mp')   bias P('mp')
+      RowParallelLinear      weight P('mp', None)   bias replicated
+      VocabParallelEmbedding weight P('mp', None)
+      everything else        replicated
+
+    Idempotent (device_put to the same sharding is a no-op) and safe on a
+    non-TP model (plain Linears all fall in the replicate bucket).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    if _mesh.get_mesh() is None or _mesh.axis_size("mp") <= 1:
+        return model
+    placed = set()
+
+    def _put(t, spec):
+        if t is None:
+            return
+        _mesh.shard_tensor_(t, spec)
+        placed.add(id(t))
+
+    for _, layer in model.named_sublayers(include_self=True):
+        if isinstance(layer, ColumnParallelLinear):
+            _put(layer.weight, P(None, "mp"))
+            _put(layer.bias, P("mp"))
+        elif isinstance(layer, RowParallelLinear):
+            _put(layer.weight, P("mp", None))
+            _put(layer.bias, P())
+        elif isinstance(layer, VocabParallelEmbedding):
+            _put(layer.weight, P("mp", None))
+        elif isinstance(layer, LlamaAttention):
+            # rope cos/sin are plain Tensors (shared across layers), not
+            # registered parameters — replicate them explicitly
+            _put(layer.rope_cos, P())
+            _put(layer.rope_sin, P())
+    for _, p in model.named_parameters():
+        if id(p) not in placed:
+            _put(p, P())
+    return model

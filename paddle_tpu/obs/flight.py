@@ -176,6 +176,11 @@ def dump(reason, path=None):
             lora = _prof.lora_summary()
             if lora:
                 header["lora"] = lora
+            # mesh topology at death: "was this replica TP-sharded, over
+            # how many devices" anchors any cross-replica comparison
+            mesh = _prof.mesh_summary()
+            if mesh:
+                header["mesh"] = mesh
             # kernel dispatch at death: "was the hot path on the Pallas
             # kernels or silently on the XLA fallback" — the perf
             # post-mortem's first question

@@ -39,6 +39,8 @@ Metric-name reference (the stable surface the scrape test pins):
     paddle_router_brownout_sheds_total / _deadline_sheds_total
     paddle_router_no_replica_total
     paddle_router_replica_state{replica=...,state=...} 1
+    paddle_mesh_devices / paddle_mesh_tp_degree
+    paddle_mesh_allreduce_per_step
     paddle_flash_fallbacks_total{reason=...}  (zero-filled label set)
     paddle_flash_pallas_calls_total{kernel=...}  (zero-filled label set)
     paddle_sanitizer_<counter>_total  (traces, eager_misses, host_syncs,
@@ -207,6 +209,16 @@ def render(labels=None):
     exp.add("paddle_lora_capacity", g["capacity"],
             "LoRA arena adapter slots (excludes the pinned base slot)",
             "gauge")
+
+    g = snap["mesh"]
+    exp.add("paddle_mesh_devices", g["devices"],
+            "jax devices visible to the serving process", "gauge")
+    exp.add("paddle_mesh_tp_degree", g["tp"],
+            "tensor-parallel degree of the serving mesh ('mp' axis size)",
+            "gauge")
+    exp.add("paddle_mesh_allreduce_per_step", g["allreduce_per_step"],
+            "static GSPMD allreduces per compiled step (row-parallel "
+            "outputs + sampling reduction; 0 at tp=1)", "gauge")
 
     g = snap["router"]
     for key, name in (

@@ -283,6 +283,7 @@ def reset():
         _reset_speculation_locked()
         _reset_lora_locked()
         _reset_router_locked()
+        _reset_mesh_locked()
         _flash_fallbacks.clear()
         _flash_pallas.clear()
 
@@ -305,6 +306,7 @@ def metrics_snapshot():
             "speculation": dict(_spec_gauges),
             "lora": dict(_lora_gauges),
             "router": router,
+            "mesh": dict(_mesh_gauges),
             "flash_fallbacks": dict(_flash_fallbacks),
             "flash_pallas": dict(_flash_pallas),
         }
@@ -374,6 +376,51 @@ def paging_summary():
         out["pages_used_peak"] = g["pages_used_peak"]
         out["pages_total"] = g["pages_total"]
     return out
+
+
+# ---------------------------------------------------------------------------
+# Mesh-topology gauges (ISSUE 14): the engine records its device mesh at
+# construction — total visible devices, tensor-parallel degree, and the
+# static per-step allreduce count GSPMD inserts for the row-parallel outputs
+# — so /metrics and the flight recorder can state which topology a replica
+# is serving on.  Pure descriptors (set, not accumulated).
+# ---------------------------------------------------------------------------
+
+_mesh_gauges = {
+    "devices": 0,            # jax devices visible to the process
+    "tp": 1,                 # tensor-parallel degree ('mp' axis size)
+    "allreduce_per_step": 0, # static GSPMD allreduces per compiled step
+}
+
+
+def record_mesh_topology(devices, tp, allreduce_per_step):
+    """Record the serving mesh topology (engine construction time)."""
+    with _counters_lock:
+        g = _mesh_gauges
+        g["devices"] = int(devices)
+        g["tp"] = int(tp)
+        g["allreduce_per_step"] = int(allreduce_per_step)
+
+
+def _reset_mesh_locked():
+    _mesh_gauges["devices"] = 0
+    _mesh_gauges["tp"] = 1
+    _mesh_gauges["allreduce_per_step"] = 0
+
+
+def reset_mesh():
+    with _counters_lock:
+        _reset_mesh_locked()
+
+
+def mesh_summary():
+    """Current mesh descriptors ({} until an engine has recorded one) —
+    consumed by the flight-recorder dump header."""
+    with _counters_lock:
+        g = dict(_mesh_gauges)
+    if not g["devices"]:
+        return {}
+    return g
 
 
 # ---------------------------------------------------------------------------

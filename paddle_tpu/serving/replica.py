@@ -387,6 +387,14 @@ def main(argv=None):
              "(forces the paged engine; weights are seeded by list position, "
              "so identical --lora strings mean identical adapters fleet-wide)",
     )
+    p.add_argument(
+        "--tp", type=int, default=1,
+        help="tensor-parallel degree: shard the model, KV arena, and fused "
+             "decode kernel over the first N devices of an 'mp' mesh (heads "
+             "and kv_heads must divide by N; greedy outputs stay "
+             "token-identical to --tp 1, so mixed-degree fleets still "
+             "satisfy the failover contract)",
+    )
     args = p.parse_args(argv)
 
     import numpy as np
@@ -398,8 +406,12 @@ def main(argv=None):
     from ..inference.engine import ContinuousBatchingEngine
     from ..models.llama import LlamaConfig, LlamaForCausalLM
 
-    model = LlamaForCausalLM(LlamaConfig.tiny())
+    model = LlamaForCausalLM(
+        LlamaConfig.tiny(tensor_parallel_degree=args.tp)
+    )
     extra = {}
+    if args.tp > 1:
+        extra["tp"] = args.tp
     if args.lora:
         # same --lora string on every worker -> same registration order ->
         # same seeds -> bit-identical adapter weights (the failover contract

@@ -1519,6 +1519,140 @@ def bench_router():
     }
 
 
+def bench_soak():
+    """Closed-loop autoscaler chaos mini-soak (ISSUE 16): a saturating
+    step-function burst of mixed organic + adversarial traffic through the
+    router while the autoscaler grows the fleet 1 -> 2 THROUGH a
+    failed-spawn drill and a poisoned decode step.  Headline is
+    requests/s/chip over the whole soak (CPU: informational); the enforced
+    gate is the robustness contract — every offered request resolves
+    exactly once, every adversarial kind lands its typed outcome, organic
+    traffic holds the SLO, and the loop actually scaled through the
+    chaos."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler
+    from paddle_tpu.fault import injection as finj
+    from paddle_tpu.inference import serve
+    from paddle_tpu.inference.engine import ContinuousBatchingEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import Replica, Router
+    from paddle_tpu.serving.autoscaler import Autoscaler
+    from paddle_tpu.serving.workload import Workload, run_soak
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    servers = {}
+
+    def _replica(rid, warm=False):
+        eng = ContinuousBatchingEngine(
+            model, slots=2, max_len=64, prefill_buckets=[8],
+            queue_depth=16, seed=0,
+        )
+        if warm:  # spawns stay cold: their compiles are process-cached and
+            eng.warmup()  # the router only routes to them after probe-ready
+        srv = serve(eng, port=0, block=False, supervise=False,
+                    handle_signals=False)
+        servers[rid] = srv
+        return Replica(rid, f"http://127.0.0.1:{srv.server_address[1]}")
+
+    def _stop(srv):
+        try:
+            srv.engine.stop()
+        except Exception:
+            pass
+        srv.shutdown()
+        srv.server_close()
+
+    profiler.reset_router()
+    profiler.reset_autoscale()
+    router = Router([_replica("r0", warm=True)], probe_interval=0.05,
+                    retry_backoff=0.02)
+    asc = None
+    try:
+        router.start()
+        deadline = time.monotonic() + 60
+        while (time.monotonic() < deadline
+               and router.replicas[0].state != "ready"):
+            time.sleep(0.05)
+        asc = Autoscaler(
+            router,
+            spawn_fn=lambda i, tp: _replica(f"as{i}"),
+            stop_fn=lambda rep: _stop(servers.pop(rep.rid)),
+            min_replicas=1, max_replicas=2, interval=0.05, up_ticks=2,
+            down_ticks=4, up_cooldown=0.2, down_cooldown=0.3,
+            up_drain_s=10.0, up_queue_depth=1.0, up_miss_rate=0.5,
+            min_page_free=0.0, down_drain_s=10.0, tp_max=1,
+            devices_total=1, drain_grace=5.0,
+        ).start()
+        wl = Workload(
+            rate_hz=500.0, duration_s=60.0, requests=400, seed=7,
+            steps=((0.0, 1.0), (0.2, 4.0)), prompt_len=(4, 8),
+            max_new_tokens=4, deadline_s=60.0, frac_over_deadline=0.03,
+            frac_unknown_adapter=0.03, frac_over_bucket=0.03,
+            max_len_hint=64,
+        )
+        report = run_soak(
+            router, wl, threads=4, realtime=False,
+            faults=((0.05, "autoscale.spawn:1,serve.decode.nan:1"),),
+        )
+        asc.stop()  # join the control thread: an in-flight spawn completes
+        gauges = profiler.autoscale_summary()
+    finally:
+        finj.disarm()
+        if asc is not None:
+            asc.stop()
+        router.stop()
+        for srv in servers.values():
+            _stop(srv)
+
+    s = report.summary()
+    chips = max(1, jax.device_count())
+    typed_ok = all(
+        s["kind_counts"].get(k, {"unexpected": 0})["unexpected"] == 0
+        for k in ("unknown_adapter", "over_bucket", "over_deadline")
+    )
+    okc = s["kind_counts"].get("ok", {"n": 0, "unexpected": 0})
+    organic_ok = (
+        okc["unexpected"] <= max(3, okc["n"] // 20)
+        and report.miss_rate <= 0.05
+    )
+    scaled = (
+        gauges.get("scale_ups", 0) >= 1
+        and gauges.get("spawn_failures", 0) >= 1
+        and gauges.get("replicas_peak", 0) >= 2
+    )
+    ok = bool(report.exactly_once and typed_ok and organic_ok and scaled)
+    return {
+        "metric": "soak_requests_per_s_per_chip",
+        "value": round(s["requests_per_s"] / chips, 2),
+        "unit": "req/s/chip",
+        "requests": s["offered"],
+        "requests_per_s": s["requests_per_s"],
+        "chips": chips,
+        "latency_p50_ms": s["latency_p50_ms"],
+        "latency_p95_ms": s["latency_p95_ms"],
+        "miss_rate": s["miss_rate"],
+        "exactly_once": report.exactly_once,
+        "scale_ups": gauges.get("scale_ups", 0),
+        "spawn_failures": gauges.get("spawn_failures", 0),
+        "replicas_peak": gauges.get("replicas_peak", 0),
+        "gate": {
+            "exactly_once": report.exactly_once,
+            "typed_adversarial_outcomes": typed_ok,
+            "organic_slo": organic_ok,
+            "scaled_through_chaos": scaled,
+            "enforced": True,
+            "ok": ok,
+        },
+        "note": "400 saturating requests (4x burst step, 9% adversarial "
+        "mix) through the router; the autoscaler scales 1 -> 2 through an "
+        "armed autoscale.spawn fault plus one serve.decode.nan poisoned "
+        "step; the 10-minute acceptance soak lives in ./ci.sh soak",
+    }
+
+
 def bench_trace_overhead():
     """FLAGS_trace cost on the serving hot path (ISSUE 10): the same
     Poisson workload through two identically-configured engines, span
@@ -1957,6 +2091,7 @@ def main():
         ("paged_decode_kernel", bench_paged_decode_kernel),
         ("tp_decode", bench_tp_decode),
         ("router_failover", bench_router),
+        ("autoscale_soak", bench_soak),
         ("trace_overhead", bench_trace_overhead),
         ("hapi_async", bench_hapi_async),
         ("moe_gshard", bench_moe),

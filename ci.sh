@@ -15,14 +15,20 @@
 #   chaos-router — the MULTI-REPLICA router drills (ISSUE 9): 2 replicas,
 #                  injected probe flap + kill -9 under Poisson load, breaker
 #                  cycle, rolling drain — exactly-once resolution end to end
+#   soak         — the ISSUE 16 acceptance soak: ~10 minutes of step-function
+#                  traffic (diurnal Poisson + 4x burst + adversarial mix)
+#                  against subprocess replicas while the closed-loop
+#                  autoscaler scales 1 -> N -> 1 through scheduled kill -9 /
+#                  hang / flap / failed-spawn chaos; exactly-once resolution,
+#                  miss rate under the bar, flight dump replays the decisions
 set -euo pipefail
 cd "$(dirname "$0")"
 
 MODE="${1:-}"
 case "${MODE:-}" in
-  ""|fast|chaos|chaos-serve|chaos-router) ;;
+  ""|fast|chaos|chaos-serve|chaos-router|soak) ;;
   *)
-    echo "usage: ./ci.sh [fast|chaos|chaos-serve|chaos-router]" >&2
+    echo "usage: ./ci.sh [fast|chaos|chaos-serve|chaos-router|soak]" >&2
     exit 2
     ;;
 esac
@@ -95,6 +101,27 @@ if [ "$MODE" = "chaos-router" ]; then
       python -m pytest tests/test_serving_router.py \
       -q -p no:cacheprovider
   echo "CHAOS-ROUTER OK"
+  exit 0
+fi
+
+if [ "$MODE" = "soak" ]; then
+  echo "== autoscaler chaos soak (ISSUE 16 acceptance, hard 18min cap) =="
+  # SOAK_DURATION_S (default 600) sets the arrival-clock length; the
+  # timeout(1) wrapper is the layer above every in-test deadline — a
+  # wedged replica boot, drain, or control loop must fail CI, not hang
+  # it.  PADDLE_OBS_DIR collects the post-mortem flight dump the test
+  # writes (scaling decisions + chaos, asserted parseable below)
+  OBS_DIR="$(mktemp -d)/flightrec"
+  timeout -k 30 1080 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      PADDLE_OBS_DIR="$OBS_DIR" \
+      SOAK_DURATION_S="${SOAK_DURATION_S:-600}" \
+      python -m pytest \
+      "tests/test_autoscale_soak.py::test_soak_step_function_chaos" \
+      -q -p no:cacheprovider
+  ls "$OBS_DIR"/flight-*.jsonl >/dev/null 2>&1 \
+      || { echo "FAIL: no flight-recorder dump after the soak" >&2; exit 1; }
+  echo "flight-recorder dumps: $(ls "$OBS_DIR" | wc -l) in $OBS_DIR"
+  echo "SOAK OK"
   exit 0
 fi
 
@@ -252,6 +279,21 @@ ROUTER_TESTS=(tests/test_serving_router.py::test_failover_retries_on_survivor_bi
 [ "$MODE" != "fast" ] && ROUTER_TESTS=(tests/test_serving_router.py)
 timeout -k 30 600 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python -m pytest "${ROUTER_TESTS[@]}" -q -m "not slow" -p no:cacheprovider
+
+echo "== autoscaler + mini-soak smoke (ISSUE 16 acceptance subset) =="
+# both tiers run the closed-loop core under the runtime sanitizer (the
+# module is sanitized: 0 unexpected recompiles through the whole cycle):
+# the live 1 -> 2 -> 1 scale cycle with a parseable flight dump, and the
+# sub-minute chaos mini-soak — 300 saturating requests, failed-spawn +
+# NaN faults, exactly-once resolution, typed adversarial outcomes; fast
+# mode runs that pair, full mode the whole non-slow file (control-law
+# units, workload determinism, Prometheus monotonicity across a warm
+# restart; the 10-minute acceptance soak lives in ./ci.sh soak)
+AUTOSCALE_TESTS=(tests/test_autoscale_soak.py::test_autoscaler_live_scale_cycle_with_flight_dump
+                 tests/test_autoscale_soak.py::test_mini_soak_chaos_scale_cycle)
+[ "$MODE" != "fast" ] && AUTOSCALE_TESTS=(tests/test_autoscale_soak.py)
+timeout -k 30 600 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python -m pytest "${AUTOSCALE_TESTS[@]}" -q -m "not slow" -p no:cacheprovider
 
 echo "== observability smoke (ISSUE 10 acceptance subset) =="
 # both tiers scrape a live replica's /metrics (stable name set, replica

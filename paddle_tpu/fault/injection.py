@@ -232,3 +232,4 @@ register("serve.loop.crash", "crashes the engine scheduler thread (EngineSupervi
 register("router.replica.hang", "HANGS the router's dispatch to one replica (wedged connection drill; bounded by the HTTP timeout)")
 register("router.replica.flap", "fails the router's /healthz probe of a replica (flapping-replica / breaker drill)")
 register("router.replica.kill", "SIGKILLs a router-managed replica process at probe time (kill -9 chaos drill)")
+register("autoscale.spawn", "fires when the autoscaler spawns a replica (failed-scale-up drill: the loop must absorb the failure and retry after the cooldown)")

@@ -588,6 +588,78 @@ define_flag(
     "safe).  0 disables hedging.",
 )
 define_flag(
+    "FLAGS_autoscale_min_replicas", 1,
+    "serving autoscaler: floor of the replica band — scale-down never "
+    "drains below this many ready replicas",
+)
+define_flag(
+    "FLAGS_autoscale_max_replicas", 4,
+    "serving autoscaler: ceiling of the replica band — scale-up never "
+    "spawns beyond this many managed replicas",
+)
+define_flag(
+    "FLAGS_autoscale_interval", 0.5,
+    "serving autoscaler: seconds between control-loop ticks (each tick "
+    "reads every replica's probe snapshot and decides up/down/hold)",
+)
+define_flag(
+    "FLAGS_autoscale_up_ticks", 2,
+    "serving autoscaler hysteresis: consecutive pressured ticks required "
+    "before a scale-up fires (one noisy probe must not spawn a replica)",
+)
+define_flag(
+    "FLAGS_autoscale_down_ticks", 6,
+    "serving autoscaler hysteresis: consecutive idle ticks required before "
+    "a scale-down fires (asymmetric on purpose: scaling up is cheap to "
+    "undo, draining a warm replica is not)",
+)
+define_flag(
+    "FLAGS_autoscale_up_cooldown", 2.0,
+    "serving autoscaler: seconds after ANY scaling action before another "
+    "scale-UP may fire (lets the new replica's probes land before the "
+    "loop judges the fleet under-provisioned again)",
+)
+define_flag(
+    "FLAGS_autoscale_down_cooldown", 10.0,
+    "serving autoscaler: seconds after ANY scaling action before a "
+    "scale-DOWN may fire (longer than up: flapping capacity away during a "
+    "burst lull re-queues real work)",
+)
+define_flag(
+    "FLAGS_autoscale_up_drain_s", 0.5,
+    "serving autoscaler pressure signal: the fleet's BEST (minimum) "
+    "queue-drain estimate above this many seconds counts as a pressured "
+    "tick — every replica already owes this much wall time",
+)
+define_flag(
+    "FLAGS_autoscale_up_queue_depth", 4.0,
+    "serving autoscaler pressure signal: mean queued requests per ready "
+    "replica above this counts as a pressured tick",
+)
+define_flag(
+    "FLAGS_autoscale_up_miss_rate", 0.05,
+    "serving autoscaler pressure signal: any replica's deadline-miss-rate "
+    "EWMA above this counts as a pressured tick (the SLO input)",
+)
+define_flag(
+    "FLAGS_autoscale_min_page_free", 0.05,
+    "serving autoscaler pressure signal: any replica's KV page-pool free "
+    "fraction below this counts as a pressured tick (arena exhaustion "
+    "rejects work the queue gauges cannot see)",
+)
+define_flag(
+    "FLAGS_autoscale_down_drain_s", 0.05,
+    "serving autoscaler idle signal: a tick is idle only when every ready "
+    "replica's drain estimate is below this, no queue holds work, and the "
+    "fleet is above the min band",
+)
+define_flag(
+    "FLAGS_autoscale_tp_max", 1,
+    "serving autoscaler: cap on the --tp degree chosen for a spawned "
+    "replica (the controller picks the largest power of two that fits the "
+    "unclaimed devices, clamped here; 1 = always single-device replicas)",
+)
+define_flag(
     "FLAGS_debug_sanitize", False,
     "runtime trace/sync sanitizer (paddle_tpu.analysis.sanitizer): count "
     "every fresh trace, eager-cache miss, and device->host sync; inside a "

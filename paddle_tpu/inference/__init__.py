@@ -258,7 +258,10 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
             # trace_id joins the failure to its span tree across hops
             self._err = err_type
             headers = {}
-            if retry_after:
+            # `is not None`, not truthiness (the router-side fix's twin):
+            # a 0.0 drain estimate still means "retry after 1s", not
+            # "no header"
+            if retry_after is not None:
                 headers["Retry-After"] = str(max(1, int(retry_after + 0.5)))
             self._reply(
                 code,

@@ -131,6 +131,12 @@ from .spec import NgramDrafter
 
 logger = logging.getLogger("paddle_tpu")
 
+# deadline-miss-rate EWMA weight per terminal resolution: ~20-request
+# memory, so one miss reads 0.05 and a sustained miss storm saturates
+# toward 1.0 within a few dozen requests — fast enough for an autoscaler
+# tick, long enough that one straggler does not flap the fleet
+_MISS_EWMA_ALPHA = 0.05
+
 
 class EngineUnavailable(RuntimeError):
     """The engine cannot take this request right now (queue full, draining,
@@ -511,6 +517,12 @@ class ContinuousBatchingEngine:
         self._watchdog_trip = None  # (region, deadline_s) set by the monitor
         self._last_progress = time.monotonic()
         self._step_ewma_s = None  # EWMA wall seconds per decode round
+        # deadline-miss RATE over terminal resolutions (EWMA, not the
+        # monotonic faults counter): 1.0 for a timeout eviction, 0.0 for a
+        # normal finish, blended at _MISS_EWMA_ALPHA — the autoscaler and
+        # brownout logic need "how often are we missing NOW", which a
+        # running total cannot answer without a scrape-side derivative
+        self._miss_ewma = 0.0
 
     # -- compiled bodies ----------------------------------------------------
 
@@ -1104,6 +1116,10 @@ class ContinuousBatchingEngine:
             # speculation is accepting drafts) — the factor decode_ewma_ms
             # must be divided by when comparing replica throughput
             "tokens_per_step": round(self._tok_rate_ewma, 3),
+            # deadline-miss-rate EWMA over terminal resolutions (ISSUE 16):
+            # always present (0.0 before any traffic) so the scrape surface
+            # and the autoscaler's pressure signal are shape-stable
+            "deadline_miss_rate": round(self._miss_ewma, 4),
             # mesh topology (ISSUE 14): degree + axis shape so a fleet
             # operator can see which replicas are TP-sharded from /healthz
             "tp": self.tp,
@@ -2241,6 +2257,19 @@ class ContinuousBatchingEngine:
             )
         elif reason == "timeout":
             _prof.record_serving_fault("deadline_miss")
+        if reason in ("eos", "length", "timeout"):
+            # miss-rate EWMA over ORGANIC terminal outcomes only — restarts
+            # and cancellations are not deadline signal; _mu (reentrant)
+            # covers resolution from both the scheduler thread and the
+            # stop/fail_all paths; mirrored into the profiler gauge so
+            # /metrics scrapes the same number /healthz reports
+            with self._mu:
+                self._miss_ewma = (
+                    (1.0 - _MISS_EWMA_ALPHA) * self._miss_ewma
+                    + _MISS_EWMA_ALPHA * (1.0 if reason == "timeout" else 0.0)
+                )
+                rate = self._miss_ewma
+            _prof.record_deadline_miss_rate(rate)
         elif reason == "cancelled":
             _prof.record_serving_fault("cancelled")
         elif reason == "restarted":

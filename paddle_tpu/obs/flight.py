@@ -181,6 +181,12 @@ def dump(reason, path=None):
             mesh = _prof.mesh_summary()
             if mesh:
                 header["mesh"] = mesh
+            # autoscaler state at death: "was the controller acting, how
+            # big was the fleet" frames every capacity post-mortem (the
+            # per-decision timeline rides the ring as 'autoscale' events)
+            asc = _prof.autoscale_summary()
+            if asc:
+                header["autoscale"] = asc
             # kernel dispatch at death: "was the hot path on the Pallas
             # kernels or silently on the XLA fallback" — the perf
             # post-mortem's first question

@@ -22,6 +22,7 @@ Metric-name reference (the stable surface the scrape test pins):
     paddle_serving_occupancy_mean / _peak
     paddle_serving_queue_depth_max
     paddle_serving_faults_total{kind=...}
+    paddle_serving_deadline_miss_rate
     paddle_paging_prefix_hits_total / _misses_total
     paddle_paging_prefill_tokens_saved_total
     paddle_paging_cow_copies_total
@@ -39,6 +40,9 @@ Metric-name reference (the stable surface the scrape test pins):
     paddle_router_brownout_sheds_total / _deadline_sheds_total
     paddle_router_no_replica_total
     paddle_router_replica_state{replica=...,state=...} 1
+    paddle_autoscaler_ticks_total / _scale_ups_total / _scale_downs_total
+    paddle_autoscaler_holds_total / _spawn_failures_total / _reaps_total
+    paddle_autoscaler_replicas / _replicas_peak
     paddle_mesh_devices / paddle_mesh_tp_degree
     paddle_mesh_allreduce_per_step
     paddle_flash_fallbacks_total{reason=...}  (zero-filled label set)
@@ -158,6 +162,13 @@ def render(labels=None):
         exp.add("paddle_serving_faults_total", faults[kind],
                 "serving fault-domain events by kind", "counter",
                 {"kind": kind})
+    # always rendered (0.0 before traffic): the autoscaler's SLO input must
+    # be a stable scrape target, not a series that appears under pressure
+    exp.add("paddle_serving_deadline_miss_rate",
+            g.get("deadline_miss_rate", 0.0),
+            "deadline-miss-rate EWMA over terminal resolutions (a rate; "
+            "the monotonic total is paddle_serving_faults_total"
+            '{kind="deadline_miss"})', "gauge")
 
     g = snap["paging"]
     exp.add("paddle_paging_prefix_hits_total", g["prefix_hits"],
@@ -239,6 +250,21 @@ def render(labels=None):
         exp.add("paddle_router_replica_state", 1,
                 "last observed state per replica (1 = current state)",
                 "gauge", {"replica": rid, "state": state})
+
+    g = snap.get("autoscale", {})
+    for key, name in (
+        ("ticks", "paddle_autoscaler_ticks_total"),
+        ("scale_ups", "paddle_autoscaler_scale_ups_total"),
+        ("scale_downs", "paddle_autoscaler_scale_downs_total"),
+        ("holds", "paddle_autoscaler_holds_total"),
+        ("spawn_failures", "paddle_autoscaler_spawn_failures_total"),
+        ("reaps", "paddle_autoscaler_reaps_total"),
+    ):
+        exp.add(name, g.get(key, 0), f"autoscaler control-loop events: {key}")
+    exp.add("paddle_autoscaler_replicas", g.get("replicas", 0),
+            "fleet size under the autoscaler's control", "gauge")
+    exp.add("paddle_autoscaler_replicas_peak", g.get("replicas_peak", 0),
+            "peak fleet size under the autoscaler's control", "gauge")
 
     # zero-filled label sets (like _FAULT_KINDS): a fallback regression must
     # show as a counter MOVING on a dashboard, not as a series appearing —

@@ -149,6 +149,10 @@ _serving_gauges = {
     "queue_depth_sum": 0,
     "queue_depth_max": 0,
     "faults": {},  # serving fault-domain counters, by kind
+    # deadline-miss-rate EWMA SET by the engine at each terminal
+    # resolution (a rate, not an accumulated counter; last writer wins —
+    # one engine per serving process in production)
+    "deadline_miss_rate": 0.0,
 }
 
 # serving fault-domain counter kinds (PR 6): engine restarts, requests
@@ -166,6 +170,15 @@ def record_serving_fault(kind, n=1):
     with _counters_lock:
         f = _serving_gauges["faults"]
         f[kind] = f.get(kind, 0) + int(n)
+
+
+def record_deadline_miss_rate(rate):
+    """Publish the engine's deadline-miss-rate EWMA (ISSUE 16): the engine
+    owns the blend (engine._MISS_EWMA_ALPHA over terminal resolutions);
+    this just makes the current value scrapeable from /metrics next to the
+    monotonic `deadline_miss` fault counter."""
+    with _counters_lock:
+        _serving_gauges["deadline_miss_rate"] = float(rate)
 
 
 def record_serving_request(ttft_s, tokens, wall_s):
@@ -199,7 +212,7 @@ def _reset_serving_locked():
     _serving_gauges.update(
         requests=0, tokens=0, ttfts_s=[], busy_s=0.0, ticks=0,
         occupancy_sum=0.0, occupancy_peak=0.0, queue_depth_sum=0,
-        queue_depth_max=0, faults={},
+        queue_depth_max=0, faults={}, deadline_miss_rate=0.0,
     )
 
 
@@ -283,6 +296,7 @@ def reset():
         _reset_speculation_locked()
         _reset_lora_locked()
         _reset_router_locked()
+        _reset_autoscale_locked()
         _reset_mesh_locked()
         _flash_fallbacks.clear()
         _flash_pallas.clear()
@@ -306,6 +320,7 @@ def metrics_snapshot():
             "speculation": dict(_spec_gauges),
             "lora": dict(_lora_gauges),
             "router": router,
+            "autoscale": dict(_autoscale_gauges),
             "mesh": dict(_mesh_gauges),
             "flash_fallbacks": dict(_flash_fallbacks),
             "flash_pallas": dict(_flash_pallas),
@@ -604,6 +619,61 @@ def router_summary():
     return g
 
 
+# ---------------------------------------------------------------------------
+# Autoscaler gauges (ISSUE 16): the closed-loop controller counts every
+# control tick and decision by direction (plus spawn failures from the
+# autoscale.spawn chaos point), and SETS the current/peak managed replica
+# count — so "did the loop act, and why is the fleet this size" is
+# answerable from profiler.summary() and /metrics without grepping flight
+# dumps.
+# ---------------------------------------------------------------------------
+
+_autoscale_gauges = {
+    "ticks": 0,
+    "scale_ups": 0,
+    "scale_downs": 0,
+    "holds": 0,
+    "spawn_failures": 0,
+    "reaps": 0,  # dead managed workers deregistered (chaos kill -9, crash)
+    "replicas": 0,  # last observed fleet size (set, not accumulated)
+    "replicas_peak": 0,
+}
+
+
+def record_autoscale_event(kind, n=1):
+    """Count one autoscaler event: 'ticks', 'scale_ups', 'scale_downs',
+    'holds', 'spawn_failures' (unknown kinds are counted too so call sites
+    never have to guard)."""
+    with _counters_lock:
+        g = _autoscale_gauges
+        g[kind] = g.get(kind, 0) + int(n)
+
+
+def record_autoscale_replicas(n):
+    """Latest fleet size under the autoscaler's control (gauge + peak)."""
+    with _counters_lock:
+        _autoscale_gauges["replicas"] = int(n)
+        if int(n) > _autoscale_gauges["replicas_peak"]:
+            _autoscale_gauges["replicas_peak"] = int(n)
+
+
+def _reset_autoscale_locked():
+    for k in _autoscale_gauges:
+        _autoscale_gauges[k] = 0
+
+
+def reset_autoscale():
+    with _counters_lock:
+        _reset_autoscale_locked()
+
+
+def autoscale_summary():
+    """Autoscaler counters ({} until the control loop has ticked)."""
+    with _counters_lock:
+        g = dict(_autoscale_gauges)
+    return g if g["ticks"] or g["scale_ups"] or g["scale_downs"] else {}
+
+
 def _pctl(sorted_vals, q):
     if not sorted_vals:
         return 0.0
@@ -797,6 +867,16 @@ class Profiler:
                         f"{k}={v}" for k, v in sorted(rt["replica_states"].items())
                     )
                 )
+        asc = autoscale_summary()
+        if asc:
+            print(
+                "autoscaler: {t} ticks  up {up}  down {dn}"
+                "  spawn failures {sf}  replicas {n} (peak {pk})".format(
+                    t=asc["ticks"], up=asc["scale_ups"], dn=asc["scale_downs"],
+                    sf=asc["spawn_failures"], n=asc["replicas"],
+                    pk=asc["replicas_peak"],
+                )
+            )
         pg = paging_summary()
         if pg.get("prefix_lookups"):
             print(

@@ -20,6 +20,10 @@ __all__ = [
     "Replica",
     "ReplicaProcess",
     "ReplicaTransportError",
+    "Autoscaler",
+    "Workload",
+    "SoakReport",
+    "run_soak",
 ]
 
 
@@ -35,4 +39,12 @@ def __getattr__(name):
         from . import replica as _replica
 
         return getattr(_replica, name)
+    if name == "Autoscaler":
+        from . import autoscaler as _autoscaler
+
+        return _autoscaler.Autoscaler
+    if name in ("Workload", "SoakReport", "run_soak"):
+        from . import workload as _workload
+
+        return getattr(_workload, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

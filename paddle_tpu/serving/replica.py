@@ -94,6 +94,8 @@ class Replica:
         self._drain_estimate_s = 0.0
         self._page_free_frac = 1.0
         self._decode_ewma_ms = 0.0
+        self._tokens_per_step = 1.0
+        self._deadline_miss_rate = 0.0
         self._lora_adapters = ()  # resident adapter names from healthz (ISSUE 12)
         self._probes_ok = 0
         self._probes_failed = 0
@@ -127,6 +129,8 @@ class Replica:
                 "drain_estimate_s": self._drain_estimate_s,
                 "page_free_frac": self._page_free_frac,
                 "decode_ewma_ms": self._decode_ewma_ms,
+                "tokens_per_step": self._tokens_per_step,
+                "deadline_miss_rate": self._deadline_miss_rate,
                 "lora_adapters": self._lora_adapters,
                 "probes_ok": self._probes_ok,
                 "probes_failed": self._probes_failed,
@@ -255,6 +259,8 @@ class Replica:
             self._drain_estimate_s = float(h.get("drain_estimate_s", 0.0))
             self._page_free_frac = float(h.get("page_free_frac", 1.0))
             self._decode_ewma_ms = float(h.get("decode_ewma_ms", 0.0))
+            self._tokens_per_step = float(h.get("tokens_per_step", 1.0))
+            self._deadline_miss_rate = float(h.get("deadline_miss_rate", 0.0))
             lora = h.get("lora")
             if isinstance(lora, dict):
                 self._lora_adapters = tuple(lora.get("adapters", ()))

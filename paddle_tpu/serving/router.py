@@ -173,6 +173,42 @@ class Router:
                 rep.probe(timeout=self.probe_timeout)
             _prof.record_router_replica_state(rep.rid, rep.state)
 
+    # -- registry (ISSUE 16: the autoscaler grows/shrinks the fleet live) ----
+
+    def add_replica(self, rep):
+        """Register one replica while traffic flows.  `self.replicas` is
+        REPLACED (copy-on-write) under `_mu`, never mutated in place: pick/
+        probe/healthz iterate whatever list object they captured, so a
+        handler mid-scan sees a consistent (if momentarily stale) fleet.
+        The new replica enters as 'connecting' — pick() ignores it until a
+        probe reports ready, so no request lands on a cold boot."""
+        rep = rep if isinstance(rep, Replica) else Replica(
+            f"r{len(self.replicas)}", rep
+        )
+        with self._mu:
+            if any(r.rid == rep.rid for r in self.replicas):
+                raise ValueError(f"replica id {rep.rid!r} already registered")
+            self.replicas = self.replicas + [rep]
+        _prof.record_router_replica_state(rep.rid, rep.state)
+        _flight.record("router", f"replica {rep.rid} registered",
+                       url=rep.base_url, fleet=len(self.replicas))
+        return rep
+
+    def remove_replica(self, rid):
+        """Deregister one replica (copy-on-write, see add_replica).  The
+        handle is returned so the caller can terminate its process; the
+        caller is responsible for having drained it first — the autoscaler
+        rides the admin-drain path exactly like rolling_restart."""
+        with self._mu:
+            rep = next((r for r in self.replicas if r.rid == rid), None)
+            if rep is None:
+                raise KeyError(f"no replica with id {rid!r}")
+            self.replicas = [r for r in self.replicas if r.rid != rid]
+        _prof.record_router_replica_state(rep.rid, "removed")
+        _flight.record("router", f"replica {rep.rid} deregistered",
+                       fleet=len(self.replicas))
+        return rep
+
     # -- selection -----------------------------------------------------------
 
     def pick(self, exclude=(), adapter=None):
@@ -539,7 +575,10 @@ class Router:
     def _error(status, err_type, msg, retriable, retry_after=None,
                trace_id=None):
         headers = {}
-        if retry_after:
+        # `is not None`, not truthiness: a deadline-clamped retry_after of
+        # 0.0 is a real "retry immediately" signal and must still emit the
+        # header (rounded up to the 1s floor HTTP clients expect)
+        if retry_after is not None:
             headers["Retry-After"] = str(max(1, int(retry_after + 0.5)))
         if trace_id:
             headers[_obs.HDR_TRACE] = trace_id

@@ -43,6 +43,7 @@ _SANITIZED_MODULES = {
     "test_lora_serving",
     "test_fused_paged_attention",
     "test_tp_serving",
+    "test_autoscale_soak",
 }
 
 

@@ -297,6 +297,70 @@ def test_breaker_open_half_open_close_cycle():
     assert g["breaker_closes"] == 1
 
 
+def test_breaker_half_open_race_single_transition():
+    """ISSUE 16 satellite: a probe success and a request failure landing
+    CONCURRENTLY on a half-open replica must serialize under the replica
+    lock into coherent transitions — whichever order wins, the breaker
+    ends closed (threshold 2: one stale failure after a close cannot
+    re-trip), the half-open trial slot is released exactly once, and the
+    close is counted exactly once."""
+    for _ in range(30):
+        prof.reset_router()
+        rep = Replica("r0", "http://127.0.0.1:9", breaker_threshold=2,
+                      breaker_cooldown=60.0)
+        rep.record_failure("x")
+        rep.record_failure("x")
+        assert rep.breaker == "open"
+        # explicit clock: past the cooldown -> half_open, trial in flight
+        assert rep.allow(now=time.monotonic() + 61.0)
+        assert rep.breaker == "half_open"
+        barrier = threading.Barrier(2)
+
+        def _probe_ok():
+            barrier.wait()
+            rep.record_success(0.01)
+
+        def _request_fail():
+            barrier.wait()
+            rep.record_failure("concurrent request failure")
+
+        threads = [threading.Thread(target=_probe_ok),
+                   threading.Thread(target=_request_fail)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # either serialization ends closed: success-first absorbs the late
+        # failure below threshold; failure-first re-opens then the success
+        # closes.  A torn interleave (stuck trial, double transition,
+        # half_open limbo) fails here.
+        assert rep.breaker == "closed"
+        assert rep._trial_inflight is False
+        assert rep.allow()  # the trial slot was released, traffic flows
+        g = prof.router_summary()
+        assert g["breaker_closes"] == 1  # exactly one close transition
+        assert g["breaker_trips"] in (1, 2)  # initial trip (+ failed trial)
+
+
+def test_error_retry_after_zero_still_emits_header():
+    """ISSUE 16 satellite: a truthy-zero retry_after (0 / 0.0, e.g. a
+    deadline-clamped drain estimate) must still emit Retry-After with the
+    >= 1s rounding — only None (no evidence) omits the header."""
+    for zero in (0, 0.0):
+        status, body, headers = Router._error(
+            503, "RouterOverloaded", "gate full", True, retry_after=zero,
+        )
+        assert status == 503
+        assert headers["Retry-After"] == "1"
+        assert body["retry_after_s"] == 0
+    # rounding is preserved for real estimates
+    _, _, headers = Router._error(503, "x", "m", True, retry_after=2.6)
+    assert headers["Retry-After"] == "3"
+    # None still means "no header"
+    _, _, headers = Router._error(504, "DeadlineExhausted", "m", False)
+    assert "Retry-After" not in headers
+
+
 def test_probe_flap_opens_breaker_then_recovers(model):
     srv, eng, url = _replica_server(model)
     router = Router([url], probe_interval=3600, retry_backoff=0.01)

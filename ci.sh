@@ -15,6 +15,11 @@
 #   chaos-router — the MULTI-REPLICA router drills (ISSUE 9): 2 replicas,
 #                  injected probe flap + kill -9 under Poisson load, breaker
 #                  cycle, rolling drain — exactly-once resolution end to end
+#   chaos-router-ha — the FRONT-DOOR kill -9 drill (ISSUE 17): kill the
+#                  router ITSELF mid-soak under the runtime sanitizer; the
+#                  warm standby replays the durable journal, re-probes the
+#                  fleet, and resumes serving — exactly-once, bit-identical
+#                  tokens, breaker/band state survives the takeover
 #   soak         — the ISSUE 16 acceptance soak: ~10 minutes of step-function
 #                  traffic (diurnal Poisson + 4x burst + adversarial mix)
 #                  against subprocess replicas while the closed-loop
@@ -26,9 +31,9 @@ cd "$(dirname "$0")"
 
 MODE="${1:-}"
 case "${MODE:-}" in
-  ""|fast|chaos|chaos-serve|chaos-router|soak) ;;
+  ""|fast|chaos|chaos-serve|chaos-router|chaos-router-ha|soak) ;;
   *)
-    echo "usage: ./ci.sh [fast|chaos|chaos-serve|chaos-router|soak]" >&2
+    echo "usage: ./ci.sh [fast|chaos|chaos-serve|chaos-router|chaos-router-ha|soak]" >&2
     exit 2
     ;;
 esac
@@ -101,6 +106,28 @@ if [ "$MODE" = "chaos-router" ]; then
       python -m pytest tests/test_serving_router.py \
       -q -p no:cacheprovider
   echo "CHAOS-ROUTER OK"
+  exit 0
+fi
+
+if [ "$MODE" = "chaos-router-ha" ]; then
+  echo "== front-door HA chaos suite (router kill -9 + takeover, hard 15min cap) =="
+  # the whole ISSUE 17 file under the runtime sanitizer: journal crash
+  # signatures (torn tail, interior corruption, bit-for-bit compaction),
+  # idempotent double-submit/join drills, successor rehydration, and the
+  # slow acceptance drill — router.crash fires mid-soak, the standby
+  # replays the journal and resumes exactly-once with bit-identical
+  # tokens and 0 unexpected recompiles.  PADDLE_OBS_DIR collects the
+  # flight dump the dying router writes (asserted below)
+  OBS_DIR="$(mktemp -d)/flightrec"
+  timeout -k 30 900 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      PADDLE_OBS_DIR="$OBS_DIR" \
+      FLAGS_debug_sanitize=1 \
+      python -m pytest tests/test_router_ha.py \
+      -q -p no:cacheprovider
+  ls "$OBS_DIR"/flight-*.jsonl >/dev/null 2>&1 \
+      || { echo "FAIL: no flight-recorder dump after the router kill -9 drill" >&2; exit 1; }
+  echo "flight-recorder dumps: $(ls "$OBS_DIR" | wc -l) in $OBS_DIR"
+  echo "CHAOS-ROUTER-HA OK"
   exit 0
 fi
 
@@ -279,6 +306,20 @@ ROUTER_TESTS=(tests/test_serving_router.py::test_failover_retries_on_survivor_bi
 [ "$MODE" != "fast" ] && ROUTER_TESTS=(tests/test_serving_router.py)
 timeout -k 30 600 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python -m pytest "${ROUTER_TESTS[@]}" -q -m "not slow" -p no:cacheprovider
+
+echo "== front-door HA smoke (ISSUE 17 acceptance subset) =="
+# both tiers run the deterministic core of the crash-proof front door:
+# a double-submitted idempotency key produces ONE generation with byte-
+# identical replays, and a successor router rehydrated from the journal
+# keeps the primary's open breaker (no re-closing onto a sick replica);
+# fast mode runs that pair, full mode the whole non-slow file (torn-tail
+# repair, bit-for-bit compaction, in-flight join, standby death
+# detection; the router kill -9 soak lives in ./ci.sh chaos-router-ha)
+HA_TESTS=(tests/test_router_ha.py::test_router_double_submit_one_generation
+          tests/test_router_ha.py::test_successor_restores_breakers_and_drains)
+[ "$MODE" != "fast" ] && HA_TESTS=(tests/test_router_ha.py)
+timeout -k 30 600 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python -m pytest "${HA_TESTS[@]}" -q -m "not slow" -p no:cacheprovider
 
 echo "== autoscaler + mini-soak smoke (ISSUE 16 acceptance subset) =="
 # both tiers run the closed-loop core under the runtime sanitizer (the

@@ -233,3 +233,4 @@ register("router.replica.hang", "HANGS the router's dispatch to one replica (wed
 register("router.replica.flap", "fails the router's /healthz probe of a replica (flapping-replica / breaker drill)")
 register("router.replica.kill", "SIGKILLs a router-managed replica process at probe time (kill -9 chaos drill)")
 register("autoscale.spawn", "fires when the autoscaler spawns a replica (failed-scale-up drill: the loop must absorb the failure and retry after the cooldown)")
+register("router.crash", "kills the serving ROUTER at probe time (front-door kill -9 drill: heartbeat goes stale, the warm standby replays the journal, re-probes the fleet, and resumes serving exactly-once)")

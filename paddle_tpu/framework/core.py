@@ -588,6 +588,32 @@ define_flag(
     "safe).  0 disables hedging.",
 )
 define_flag(
+    "FLAGS_router_idem_ttl", 300.0,
+    "crash-proof front door: seconds a completed response stays cached "
+    "against its X-Idempotency-Key (router AND serve-side dedupe).  Within "
+    "the TTL a resubmitted key replays the stored bytes instead of "
+    "generating again; an in-flight resubmit joins the live request",
+)
+define_flag(
+    "FLAGS_router_journal_segment_records", 1024,
+    "control-plane journal: records per append-only segment file before "
+    "rotating to a new one (checksummed lines, atomic-rename compaction; "
+    "see serving/journal.py)",
+)
+define_flag(
+    "FLAGS_router_takeover_timeout", 2.0,
+    "router standby: seconds the primary's heartbeat seq may sit still "
+    "(on the STANDBY's own clock — no cross-process clock comparison) "
+    "before the standby declares it dead and takes over",
+)
+define_flag(
+    "FLAGS_router_retry_after_jitter", 0.25,
+    "serving router: +/- fractional jitter applied to Retry-After values "
+    "emitted on sheds (brownout, no-replica, deadline-infeasible) so "
+    "simultaneous 503s during takeover don't resynchronize clients into a "
+    "thundering herd at the successor.  0 disables jitter",
+)
+define_flag(
     "FLAGS_autoscale_min_replicas", 1,
     "serving autoscaler: floor of the replica band — scale-down never "
     "drains below this many ready replicas",
@@ -658,6 +684,13 @@ define_flag(
     "serving autoscaler: cap on the --tp degree chosen for a spawned "
     "replica (the controller picks the largest power of two that fits the "
     "unclaimed devices, clamped here; 1 = always single-device replicas)",
+)
+define_flag(
+    "FLAGS_autoscale_down_idle_tokens_s", 0.0,
+    "serving autoscaler cost signal: a scale-down additionally requires at "
+    "least this much reclaimable idle decode capacity (tokens/s summed "
+    "over idle ready replicas) — down-scaling optimizes $/token, not just "
+    "emptiness.  0 keeps the pure-emptiness behavior",
 )
 define_flag(
     "FLAGS_debug_sanitize", False,

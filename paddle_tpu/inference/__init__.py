@@ -182,7 +182,12 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
       FLAGS_serve_spec_k (0 opts out of speculation; omitted = engine
       default).  "adapter" names a registered LoRA adapter served from the
       engine's adapter arena (omitted = base model); an unregistered name
-      is a typed 404 (`AdapterUnknown`, retriable: false)
+      is a typed 404 (`AdapterUnknown`, retriable: false).  An
+      `X-Idempotency-Key` header dedupes server-side: a completed key
+      replays its cached response byte-identical (marked
+      `X-Idempotency-Replay`) within `FLAGS_router_idem_ttl`, an in-flight
+      key joins the live generation — at most one generation per key even
+      through connection resets and router failover
 
     A ContinuousBatchingEngine serves /generate with true continuous
     batching: concurrent requests decode interleaved in the slot pool, each
@@ -234,12 +239,30 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
     # (the engine has its own bounded queue — submit raises QueueFull)
     gate = threading.BoundedSemaphore(int(_fcore.flag("FLAGS_serve_queue_depth")))
     state = {"draining": False}
+    # crash-proof front door (ISSUE 17): replica-side request dedupe.  A
+    # /generate carrying X-Idempotency-Key completes into this cache BEFORE
+    # its response bytes go out, so a connection reset (or a dead router)
+    # after the generation finished leaves the response replayable — the
+    # retry through the successor router gets the SAME bytes, not a second
+    # generation.  journal-module import is stdlib-light by design.
+    idem = None
+    if engine is not None:
+        from ..serving.journal import IdempotencyCache
+
+        idem = IdempotencyCache()
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
             pass
 
         def _reply(self, code, payload, headers=None):
+            key = getattr(self, "_idem_key", None)
+            if key is not None and idem is not None:
+                # complete BEFORE any response byte leaves: a reset between
+                # completion and delivery must leave the response cached for
+                # the client's (or successor router's) keyed retry
+                self._idem_key = None
+                idem.complete(key, code, payload, headers)
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
@@ -410,10 +433,17 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
             self._trace_id = ctx[0] if ctx else _obs.new_trace_id()
             self._handle_sid = _obs.new_span_id()
             self._err = None
+            self._idem_key = None
             t0 = _time.perf_counter()
             try:
                 self._do_post()
             finally:
+                key = getattr(self, "_idem_key", None)
+                if key is not None and idem is not None:
+                    # the handler died without replying: wake joiners with
+                    # no response so their keyed retries re-execute
+                    self._idem_key = None
+                    idem.abandon(key)
                 _obs.record(
                     "serve.handle", self._trace_id,
                     t0=t0, t1=_time.perf_counter(),
@@ -429,6 +459,27 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
                            err_type="Draining")
                 return
             if self.path == "/generate" and engine is not None:
+                key = self.headers.get("X-Idempotency-Key")
+                if key and idem is not None:
+                    verdict, val = idem.begin(key)
+                    if verdict == "done":
+                        status, body, hdrs = val
+                        self._reply(status, body, headers={
+                            **(hdrs or {}), "X-Idempotency-Replay": "hit",
+                        })
+                        return
+                    if verdict == "join":
+                        resp = idem.wait(val)
+                        if resp is not None:
+                            status, body, hdrs = resp
+                            self._reply(status, body, headers={
+                                **(hdrs or {}), "X-Idempotency-Replay": "join",
+                            })
+                            return
+                        self._busy("idempotent join aborted; retry with the "
+                                   "same key")
+                        return
+                    self._idem_key = key  # first sight: generate, then cache
                 self._generate_engine()
                 return
             if self.path == "/generate" and isinstance(predictor, GenerationPredictor):

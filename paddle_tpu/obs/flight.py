@@ -49,7 +49,7 @@ _armed = {}
 # span names mirrored into the ring on completion (trace.record calls
 # note_span for every span; only request-terminal ones ride the ring)
 _SPAN_KINDS = ("router.admit", "serve.handle", "replica.forward",
-               "fit.window")
+               "fit.window", "router.takeover")
 
 
 def _ensure_capacity_locked():

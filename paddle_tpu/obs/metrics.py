@@ -39,6 +39,10 @@ Metric-name reference (the stable surface the scrape test pins):
     paddle_router_hedges_total / _hedge_wins_total
     paddle_router_brownout_sheds_total / _deadline_sheds_total
     paddle_router_no_replica_total
+    paddle_router_idem_hits_total / _idem_joins_total
+    paddle_router_journal_appends_total / _compactions_total /
+        _torn_records_total
+    paddle_router_takeovers_total / _crashes_total
     paddle_router_replica_state{replica=...,state=...} 1
     paddle_autoscaler_ticks_total / _scale_ups_total / _scale_downs_total
     paddle_autoscaler_holds_total / _spawn_failures_total / _reaps_total
@@ -244,6 +248,13 @@ def render(labels=None):
         ("brownout_sheds", "paddle_router_brownout_sheds_total"),
         ("deadline_sheds", "paddle_router_deadline_sheds_total"),
         ("no_replica", "paddle_router_no_replica_total"),
+        ("idem_hits", "paddle_router_idem_hits_total"),
+        ("idem_joins", "paddle_router_idem_joins_total"),
+        ("journal_appends", "paddle_router_journal_appends_total"),
+        ("journal_compactions", "paddle_router_journal_compactions_total"),
+        ("journal_torn_records", "paddle_router_journal_torn_records_total"),
+        ("takeovers", "paddle_router_takeovers_total"),
+        ("crashes", "paddle_router_crashes_total"),
     ):
         exp.add(name, g.get(key, 0), f"router events: {key}")
     for rid, state in sorted(g["replica_states"].items()):

@@ -581,6 +581,13 @@ _router_gauges = {
     "brownout_sheds": 0,
     "deadline_sheds": 0,
     "no_replica": 0,
+    "idem_hits": 0,
+    "idem_joins": 0,
+    "journal_appends": 0,
+    "journal_compactions": 0,
+    "journal_torn_records": 0,
+    "takeovers": 0,
+    "crashes": 0,
     "replica_states": {},  # replica id -> last observed state string
 }
 
@@ -588,7 +595,9 @@ _router_gauges = {
 def record_router_event(kind, n=1):
     """Count one router event: 'requests', 'retries', 'failovers',
     'breaker_trips', 'breaker_half_open', 'breaker_closes', 'hedges',
-    'hedge_wins', 'brownout_sheds', 'deadline_sheds', 'no_replica'
+    'hedge_wins', 'brownout_sheds', 'deadline_sheds', 'no_replica',
+    'idem_hits', 'idem_joins', 'journal_appends', 'journal_compactions',
+    'journal_torn_records', 'takeovers', 'crashes'
     (unknown kinds are counted too so call sites never have to guard)."""
     with _counters_lock:
         g = _router_gauges

@@ -13,10 +13,15 @@ from __future__ import annotations
 __all__ = [
     "Router",
     "RouterError",
+    "RouterCrashed",
+    "RouterStandby",
     "NoReadyReplica",
     "RouterOverloaded",
     "DeadlineExhausted",
     "serve_router",
+    "Journal",
+    "JournalCorruption",
+    "IdempotencyCache",
     "Replica",
     "ReplicaProcess",
     "ReplicaTransportError",
@@ -29,12 +34,17 @@ __all__ = [
 
 def __getattr__(name):
     if name in (
-        "Router", "RouterError", "NoReadyReplica", "RouterOverloaded",
-        "DeadlineExhausted", "serve_router",
+        "Router", "RouterError", "RouterCrashed", "RouterStandby",
+        "NoReadyReplica", "RouterOverloaded", "DeadlineExhausted",
+        "serve_router",
     ):
         from . import router as _router
 
         return getattr(_router, name)
+    if name in ("Journal", "JournalCorruption", "IdempotencyCache"):
+        from . import journal as _journal
+
+        return getattr(_journal, name)
     if name in ("Replica", "ReplicaProcess", "ReplicaTransportError"):
         from . import replica as _replica
 

@@ -65,7 +65,7 @@ from .replica import Replica, ReplicaProcess
 # full signal vector, rounded — a dump must justify the decision alone)
 _SIGNAL_KEYS = (
     "replicas", "ready", "min_drain_s", "max_drain_s", "mean_queue",
-    "max_miss_rate", "min_page_free", "busy",
+    "max_miss_rate", "min_page_free", "busy", "idle_tokens_per_s",
 )
 
 
@@ -92,6 +92,17 @@ def load_signals(snapshots):
             (s.get("page_free_frac", 1.0) for s in ready), default=1.0
         ),
         "busy": any(s["queue_depth"] or s["active_slots"] for s in ready),
+        # the cost signal (ROADMAP item 3): decode capacity sitting idle
+        # RIGHT NOW, in tokens/s — per idle ready replica, its per-step
+        # token yield over its EWMA step time.  What a scale-down would
+        # reclaim; 0.0 when every ready replica holds work (reclaiming a
+        # busy replica is not a cost win, it is a capacity loss)
+        "idle_tokens_per_s": sum(
+            s.get("tokens_per_step", 1.0) * (1e3 / ewma)
+            for s in ready
+            if not (s["queue_depth"] or s["active_slots"])
+            and (ewma := s.get("decode_ewma_ms", 0.0)) > 0
+        ),
     }
 
 
@@ -125,11 +136,24 @@ def decide(sig, cfg):
         and not sig["busy"]
         and sig["max_drain_s"] <= cfg["down_drain_s"]
         and sig["max_miss_rate"] <= cfg["up_miss_rate"]
+        # the $/token gate (ROADMAP item 3): only shrink when the fleet is
+        # actually wasting decode capacity — emptiness alone does not
+        # justify a drain when the reclaimable idle throughput is below
+        # the configured floor (0.0 keeps the pure-emptiness behavior)
+        and sig.get("idle_tokens_per_s", 0.0)
+        >= cfg.get("down_min_idle_tokens_s", 0.0)
     ):
-        return "down", (
+        reason = (
             f"idle: max drain {sig['max_drain_s']:.2f}s <= "
             f"{cfg['down_drain_s']}s, no queued/active work"
         )
+        idle_tok = sig.get("idle_tokens_per_s", 0.0)
+        if idle_tok > 0:
+            chips = max(1, int(cfg.get("chips", 1)))
+            reason += (
+                f", reclaim {idle_tok / chips:.1f} idle tokens/s/chip"
+            )
+        return "down", reason
     return "hold", "within band"
 
 
@@ -164,8 +188,8 @@ class Autoscaler:
                  up_ticks=None, down_ticks=None, up_cooldown=None,
                  down_cooldown=None, up_drain_s=None, up_queue_depth=None,
                  up_miss_rate=None, min_page_free=None, down_drain_s=None,
-                 tp_max=None, devices_total=None, kv_heads=None,
-                 drain_grace=None, log_dir=None):
+                 down_min_idle_tokens_s=None, tp_max=None, devices_total=None,
+                 kv_heads=None, drain_grace=None, log_dir=None, journal=None):
         f = _core.flag
 
         def _pick(v, name, cast):
@@ -198,6 +222,9 @@ class Autoscaler:
                 min_page_free, "FLAGS_autoscale_min_page_free", float),
             "down_drain_s": _pick(
                 down_drain_s, "FLAGS_autoscale_down_drain_s", float),
+            "down_min_idle_tokens_s": _pick(
+                down_min_idle_tokens_s,
+                "FLAGS_autoscale_down_idle_tokens_s", float),
         }
         self.tp_max = _pick(tp_max, "FLAGS_autoscale_tp_max", int)
         if devices_total is None:
@@ -207,6 +234,7 @@ class Autoscaler:
             except Exception:
                 devices_total = 1
         self.devices_total = int(devices_total)
+        self.cfg["chips"] = max(1, self.devices_total)
         self.kv_heads = kv_heads
         self.drain_grace = float(
             drain_grace if drain_grace is not None
@@ -221,6 +249,29 @@ class Autoscaler:
         self._up_streak = 0
         self._down_streak = 0
         self._last_action_t = None  # monotonic time of the last up/down
+        # durable control plane (ISSUE 17): default to the router's journal
+        # so a journaled router automatically journals its controller too.
+        # A RESUMED journal restores the cooldown clock — the journaled
+        # wall-clock elapsed-since-last-action maps onto THIS process's
+        # monotonic clock, so a successor does not flap the fleet the
+        # moment it takes over (the primary's cooldown still binds).
+        self.journal = (
+            journal if journal is not None
+            else getattr(router, "journal", None)
+        )
+        if self.journal is not None and self.journal.resumed:
+            st = self.journal.state_snapshot().get("autoscale")
+            if st:
+                elapsed = max(0.0, time.time() - st["last_action_wall"])
+                self._last_action_t = time.monotonic() - elapsed
+                self._up_streak = int(st.get("up_streak", 0))
+                self._down_streak = int(st.get("down_streak", 0))
+                _flight.record(
+                    "autoscale",
+                    f"cooldown clock restored from journal "
+                    f"({elapsed:.2f}s since last action)",
+                    band=st.get("band"),
+                )
         # one control lock serializes ticks: the background loop and any
         # inline tick() caller (tests, the soak harness) never interleave
         # a decision — scale actions are strictly sequential
@@ -299,6 +350,17 @@ class Autoscaler:
         else:
             self._last_action_t = now
             self._up_streak = self._down_streak = 0
+            if self.journal is not None:
+                # journal the band + cooldown clock in WALL time (monotonic
+                # clocks do not survive process death): a successor maps
+                # elapsed-since-action back onto its own monotonic clock
+                self.journal.append(
+                    "autoscale",
+                    band=[self.min_replicas, self.max_replicas],
+                    last_action_wall=time.time(),
+                    up_streak=self._up_streak,
+                    down_streak=self._down_streak,
+                )
         return {"want": want, "action": action, "reason": reason,
                 "signals": sig}
 

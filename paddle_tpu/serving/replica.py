@@ -99,6 +99,12 @@ class Replica:
         self._lora_adapters = ()  # resident adapter names from healthz (ISSUE 12)
         self._probes_ok = 0
         self._probes_failed = 0
+        # crash-proof front door (ISSUE 17): breaker transitions are
+        # journaled so a successor router does not re-close onto a sick
+        # replica; open_until is mirrored in wall time because monotonic
+        # clocks do not survive process death
+        self._journal = None
+        self._open_until_wall = 0.0
 
     # -- snapshots -----------------------------------------------------------
 
@@ -139,6 +145,44 @@ class Replica:
     def set_admin_draining(self, flag):
         with self._mu:
             self._admin_draining = bool(flag)
+            journal = self._journal
+        if journal is not None:
+            journal.append("replica", op="drain", rid=self.rid,
+                           draining=bool(flag))
+
+    # -- durable control plane (ISSUE 17) ------------------------------------
+
+    def bind_journal(self, journal):
+        """Attach the control-plane journal: breaker transitions and drain
+        decisions append to it from here on (appends happen OUTSIDE `_mu` —
+        the journal has its own lock)."""
+        with self._mu:
+            self._journal = journal
+
+    def restore_breaker(self, state, fails, open_until_wall, now=None):
+        """Rehydrate breaker state from a journal replay.  The journaled
+        open-until is wall clock; convert the REMAINING cooldown onto this
+        process's monotonic clock (an expired cooldown restores as open
+        with an immediate half-open trial — safe either way)."""
+        now = time.time() if now is None else now
+        remaining = max(0.0, float(open_until_wall) - now)
+        with self._mu:
+            if state == "open":
+                self._breaker = "open"
+                self._open_until = time.monotonic() + remaining
+                self._open_until_wall = float(open_until_wall)
+            else:
+                self._breaker = "closed"
+                self._open_until = 0.0
+                self._open_until_wall = 0.0
+            self._fails = int(fails)
+            self._trial_inflight = False
+
+    def _journal_breaker(self, journal, state, fails, open_until_wall):
+        if journal is not None:
+            journal.append("breaker", rid=self.rid, state=state,
+                           fails=int(fails),
+                           open_until_wall=float(open_until_wall))
 
     # -- circuit breaker -----------------------------------------------------
 
@@ -180,15 +224,18 @@ class Replica:
             self._trial_inflight = False
             if self._breaker != "closed":
                 self._breaker = "closed"
+                self._open_until_wall = 0.0
                 closed = True
             if latency_s is not None:
                 self._ewma_latency_s = (
                     latency_s if self._ewma_latency_s is None
                     else 0.8 * self._ewma_latency_s + 0.2 * latency_s
                 )
+            journal = self._journal
         if closed:
             _prof.record_router_event("breaker_closes")
             _flight.record("breaker", f"{self.rid} -> closed")
+            self._journal_breaker(journal, "closed", 0, 0.0)
 
     def record_failure(self, reason=""):
         """A sick-replica signal (transport failure, failed probe, engine
@@ -205,13 +252,17 @@ class Replica:
             ):
                 self._breaker = "open"
                 self._open_until = now + self.breaker_cooldown
+                self._open_until_wall = time.time() + self.breaker_cooldown
                 tripped = True
+            open_until_wall = self._open_until_wall
+            journal = self._journal
         if tripped:
             _prof.record_router_event("breaker_trips")
             _flight.record(
                 "breaker", f"{self.rid} -> open: {reason}",
                 fails=fails, cooldown_s=self.breaker_cooldown,
             )
+            self._journal_breaker(journal, "open", fails, open_until_wall)
 
     # -- probing -------------------------------------------------------------
 
@@ -280,7 +331,7 @@ class Replica:
     # -- transport -----------------------------------------------------------
 
     def post_generate(self, payload, remaining_s=None, timeout=None,
-                      trace=None):
+                      trace=None, idem_key=None):
         """One /generate dispatch.  Forwards the remaining deadline budget
         as X-Deadline-Ms (the hop contract serve() decodes back into
         `EngineRequest.deadline_s`) and the trace context as X-Trace-Id /
@@ -301,6 +352,11 @@ class Replica:
         )
         if remaining_s is not None:
             req.add_header("X-Deadline-Ms", str(int(remaining_s * 1e3)))
+        if idem_key:
+            # serve-side dedupe: replica replays its cached response when a
+            # router retry (or a successor router) resubmits a key whose
+            # generation already completed — exactly one generation per key
+            req.add_header("X-Idempotency-Key", str(idem_key))
         if trace is not None:
             req.add_header(_obs.HDR_TRACE, trace[0])
             if trace[1]:

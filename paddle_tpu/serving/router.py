@@ -47,6 +47,19 @@ Chaos: `router.replica.hang` wedges one dispatch (bounded by the HTTP
 timeout), `router.replica.flap` fails probes, `router.replica.kill`
 SIGKILLs a managed replica at probe time — all armed through the same
 `FLAGS_fault_inject` registry production uses.
+
+Crash-proof front door (ISSUE 17): with a `journal=` the router writes
+every breaker transition, registry/drain decision, and idempotency
+outcome into `serving.journal.Journal` (append-only, checksummed,
+atomic-rename segments) and beats a rank-0 heartbeat from its probe
+loop.  Requests carrying an `X-Idempotency-Key` dedupe against a TTL'd
+completed-response cache with an in-flight join — a client retry after a
+connection reset can never produce two generations.  `router.crash`
+(kill -9 drill) stops the heartbeat; a `RouterStandby` detects the stale
+seq on ITS OWN clock, replays the journal (repairing a torn tail),
+restores breakers so they don't re-close onto sick replicas, re-probes
+the fleet, and resumes serving — takeover state machine: WATCHING ->
+TAKING_OVER -> SERVING.
 """
 
 from __future__ import annotations
@@ -61,6 +74,7 @@ from ..framework import core as _core
 from ..obs import flight as _flight
 from ..obs import metrics as _obs_metrics
 from ..obs import trace as _obs
+from .journal import IdempotencyCache, Journal
 from .replica import Replica, ReplicaTransportError
 
 
@@ -90,6 +104,14 @@ class DeadlineExhausted(RouterError):
     retriable = False
 
 
+class RouterCrashed(RuntimeError):
+    """The router process is dead (the `router.crash` kill -9 drill): an
+    in-process caller sees this exception where an HTTP client would see a
+    connection reset — never a typed response.  The contract for callers:
+    resubmit the SAME idempotency key against the successor router; dedupe
+    (router- and replica-side) guarantees at most one generation."""
+
+
 class Router:
     """Front-end router over N serve() replicas.  Thread-safe: handler
     threads call `handle_generate()` concurrently with the probe thread
@@ -99,7 +121,8 @@ class Router:
 
     def __init__(self, replicas, probe_interval=None, probe_timeout=None,
                  max_retries=None, retry_backoff=None, max_inflight=None,
-                 hedge_s=None, seed=0):
+                 hedge_s=None, seed=0, journal=None, heartbeat=None,
+                 idem_ttl=None):
         self.replicas = [
             r if isinstance(r, Replica) else Replica(f"r{i}", r)
             for i, r in enumerate(replicas)
@@ -124,11 +147,88 @@ class Router:
             else f("FLAGS_router_max_inflight"))
         self.hedge_s = float(
             hedge_s if hedge_s is not None else f("FLAGS_router_hedge_s"))
+        self.idem_ttl = float(
+            idem_ttl if idem_ttl is not None else f("FLAGS_router_idem_ttl"))
+        self._retry_after_jitter = float(f("FLAGS_router_retry_after_jitter"))
         self._mu = threading.Lock()
         self._rng = random.Random(seed)  # jitter; accessed under _mu
         self._inflight = 0
         self._stop = threading.Event()
         self._probe_thread = None
+        self._crashed = False
+        self._takeovers = 0
+        # crash-proof front door (ISSUE 17): journal = durable control
+        # plane (a path string opens/replays one), heartbeat = rank-0
+        # liveness the standby watches (a path string starts a writer)
+        self.journal = (
+            journal if journal is None or isinstance(journal, Journal)
+            else Journal(journal)
+        )
+        if heartbeat is None or not isinstance(heartbeat, str):
+            self._heartbeat = heartbeat
+        else:
+            from ..fault import heartbeat as _hb
+
+            self._heartbeat = _hb.HeartbeatWriter(heartbeat, rank=0,
+                                                  interval=0.0)
+        self._idem = IdempotencyCache(self.idem_ttl, journal=self.journal)
+        if self.journal is not None:
+            self._bootstrap_from_journal()
+
+    def _bootstrap_from_journal(self):
+        """With a FRESH journal, seed it with the fleet registry.  With a
+        RESUMED journal (this router is the successor after a takeover),
+        rehydrate first: re-create journaled replicas missing from the
+        registry, restore breaker state (so the successor does not re-close
+        onto a replica the primary already knew was sick), drain flags, and
+        the completed-response idempotency entries; the autoscaler picks its
+        band/cooldown clocks out of the same state.  Journal binding to the
+        replicas happens LAST so restoration itself is never re-journaled."""
+        j = self.journal
+        resumed = j.resumed
+        st = j.state_snapshot() if resumed else None
+        if resumed:
+            t0 = time.perf_counter()
+            reps = list(self.replicas)
+            known = {r.rid for r in reps}
+            for rid, info in st["replicas"].items():
+                if rid not in known:
+                    reps.append(Replica(rid, info["url"]))
+            with self._mu:
+                self.replicas = reps
+            by_rid = {r.rid: r for r in reps}
+            for rid, info in st["replicas"].items():
+                rep = by_rid.get(rid)
+                if rep is not None and info.get("draining"):
+                    rep.set_admin_draining(True)
+            for rid, b in st["breakers"].items():
+                rep = by_rid.get(rid)
+                if rep is not None:
+                    rep.restore_breaker(
+                        b["breaker"], b["fails"], b["open_until_wall"]
+                    )
+            restored = self._idem.restore(st["idem"])
+            with self._mu:
+                self._takeovers = int(st["takeovers"]) + 1
+                takeovers = self._takeovers
+            j.append("takeover")
+            _prof.record_router_event("takeovers")
+            _flight.record(
+                "router",
+                f"takeover #{takeovers}: journal replayed to seq {st['seq']}",
+                replicas=len(reps), breakers=len(st["breakers"]),
+                idem_restored=restored,
+            )
+            _obs.record(
+                "router.takeover", _obs.new_trace_id(), t0=t0,
+                t1=time.perf_counter(), status="ok", takeovers=takeovers,
+                journal_seq=st["seq"],
+            )
+        for rep in self.replicas:
+            if not resumed or rep.rid not in st["replicas"]:
+                j.append("replica", op="register", rid=rep.rid,
+                         url=rep.base_url)
+            rep.bind_journal(j)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -163,6 +263,9 @@ class Router:
         tests call it inline for deterministic drills)."""
         from ..fault import injection as _inj
 
+        if _inj.should_fire("router.crash"):
+            self._crash("injected router.crash")
+            return
         for rep in self.replicas:
             if (rep.process is not None
                     and _inj.should_fire("router.replica.kill", context=rep.rid)):
@@ -172,6 +275,40 @@ class Router:
             else:
                 rep.probe(timeout=self.probe_timeout)
             _prof.record_router_replica_state(rep.rid, rep.state)
+        hb = self._heartbeat
+        if hb is not None:
+            try:
+                # the heartbeat rides the probe loop: seq advancing means
+                # the front door is both alive AND sweeping its fleet
+                hb.beat()
+            except OSError:
+                pass
+
+    def _crash(self, reason):
+        """Model kill -9 of the front door (the router.crash drill): every
+        in-flight and subsequent handle_generate raises RouterCrashed (the
+        HTTP layer drops the connection — clients see a reset, never a
+        typed response), the probe loop stops, and the heartbeat goes stale
+        so a RouterStandby detects death on ITS OWN clock and takes over.
+        The journal is NOT closed gracefully — a real SIGKILL wouldn't —
+        which is exactly what the torn-tail repair path is for."""
+        with self._mu:
+            if self._crashed:
+                return
+            self._crashed = True
+        self._stop.set()
+        _prof.record_router_event("crashes")
+        _flight.record("router", f"router crashed: {reason}")
+        _flight.dump("router-crash")
+        hb = self._heartbeat
+        if hb is not None:
+            hb.stop()
+
+    def _check_crashed(self):
+        with self._mu:
+            crashed = self._crashed
+        if crashed:
+            raise RouterCrashed("router process is dead (kill -9 drill)")
 
     # -- registry (ISSUE 16: the autoscaler grows/shrinks the fleet live) ----
 
@@ -192,6 +329,10 @@ class Router:
         _prof.record_router_replica_state(rep.rid, rep.state)
         _flight.record("router", f"replica {rep.rid} registered",
                        url=rep.base_url, fleet=len(self.replicas))
+        if self.journal is not None:
+            self.journal.append("replica", op="register", rid=rep.rid,
+                                url=rep.base_url)
+            rep.bind_journal(self.journal)
         return rep
 
     def remove_replica(self, rid):
@@ -207,6 +348,8 @@ class Router:
         _prof.record_router_replica_state(rep.rid, "removed")
         _flight.record("router", f"replica {rep.rid} deregistered",
                        fleet=len(self.replicas))
+        if self.journal is not None:
+            self.journal.append("replica", op="deregister", rid=rep.rid)
         return rep
 
     # -- selection -----------------------------------------------------------
@@ -261,26 +404,90 @@ class Router:
         )
         with self._mu:
             inflight = self._inflight
+            takeovers = self._takeovers
         return {
             "status": "ready" if ready else "degraded",
             "ready_replicas": ready,
             "replicas": snaps,
             "inflight": inflight,
+            "breakers": {s["id"]: s["breaker"] for s in snaps},
+            "takeovers": takeovers,
+            "journal_seq": self.journal.seq if self.journal is not None else None,
+            "idempotency": self._idem.stats(),
         }
 
     # -- routing -------------------------------------------------------------
 
-    def handle_generate(self, payload, deadline_ms=None, trace=None):
+    def handle_generate(self, payload, deadline_ms=None, trace=None,
+                        idem_key=None):
         """Route one /generate body.  Returns (status, body, headers);
         every request resolves exactly once — a success from exactly one
         replica, or ONE typed error.
+
+        `idem_key` (or a body ``idempotency_key``, which is stripped before
+        forwarding) engages the crash-proof front door: a key already
+        completed within the TTL replays the stored response byte-identical
+        (``X-Idempotency-Replay: hit``); a key currently in flight JOINS
+        the live request instead of double-generating (``: join``); only a
+        first sight executes.  Retriable outcomes (sheds, restarts) are
+        never cached, so a later retry re-executes safely.
 
         `trace` is the client hop's `(trace_id, parent_span_id)` from
         ``X-Trace-Id``/``X-Parent-Span`` (or None: the router is the first
         hop and mints the trace id).  The whole handle is recorded as the
         ``router.admit`` root span; error bodies carry the trace id even
         when span recording is off."""
+        if idem_key is None and isinstance(payload, dict):
+            idem_key = payload.pop("idempotency_key", None)
+        self._check_crashed()
         _prof.record_router_event("requests")
+        if not idem_key:
+            return self._handle_routed(payload, deadline_ms, trace, None)
+        verdict, val = self._idem.begin(idem_key)
+        if verdict == "done":
+            return self._replayed(val, "hit")
+        if verdict == "join":
+            timeout = (
+                max(0.05, float(deadline_ms) / 1e3)
+                if deadline_ms is not None else 600.0
+            )
+            resp = self._idem.wait(val, timeout=timeout)
+            self._check_crashed()
+            if resp is not None:
+                return self._replayed(resp, "join")
+            return self._error(
+                503, "IdempotentJoinAborted",
+                f"in-flight request for key {idem_key!r} ended without a "
+                "response; retry with the same key", True,
+                self._jitter_retry_after(self.healthiest_retry_after()),
+            )
+        try:
+            status, body, headers = self._handle_routed(
+                payload, deadline_ms, trace, idem_key
+            )
+        except BaseException:
+            self._idem.abandon(idem_key)
+            raise
+        with self._mu:
+            crashed = self._crashed
+        if crashed:
+            # the router died while this request was in flight: the client
+            # saw a reset, never these bytes.  Abandon the entry — any
+            # completed generation is cached REPLICA-side, so the client's
+            # resubmit through the successor replays it, not re-generates.
+            self._idem.abandon(idem_key)
+            raise RouterCrashed("router crashed mid-request")
+        self._idem.complete(idem_key, status, body, headers)
+        return status, body, headers
+
+    @staticmethod
+    def _replayed(resp, how):
+        status, body, hdrs = resp
+        headers = dict(hdrs or {})
+        headers["X-Idempotency-Replay"] = how
+        return status, body, headers
+
+    def _handle_routed(self, payload, deadline_ms, trace, idem_key):
         tid = trace[0] if trace else _obs.new_trace_id()
         client_sid = trace[1] if trace else None
         admit_sid = _obs.new_span_id()  # pre-minted: children parent on it
@@ -299,7 +506,10 @@ class Router:
                 "admission", "router gate full (brownout shed)",
                 trace_id=tid, max_inflight=self.max_inflight,
             )
-            ra = self._clamp_retry_after(self.healthiest_retry_after(), deadline_t)
+            ra = self._clamp_retry_after(
+                self._jitter_retry_after(self.healthiest_retry_after()),
+                deadline_t,
+            )
             out = self._error(
                 503, "RouterOverloaded", "router admission gate full", True,
                 ra, trace_id=tid,
@@ -312,7 +522,7 @@ class Router:
             return out
         try:
             status, body, headers = self._dispatch(
-                payload, deadline_t, (tid, admit_sid)
+                payload, deadline_t, (tid, admit_sid), idem_key=idem_key
             )
         finally:
             with self._mu:
@@ -325,7 +535,7 @@ class Router:
         )
         return status, body, headers
 
-    def _dispatch(self, payload, deadline_t, trace):
+    def _dispatch(self, payload, deadline_t, trace, idem_key=None):
         tid, admit_sid = trace
         tried = set()
         attempt = 0
@@ -358,7 +568,8 @@ class Router:
                         504, "DeadlineUnattainable",
                         f"no replica can meet the deadline (best drain "
                         f"estimate {min(drains):.2f}s > remaining "
-                        f"{remaining:.2f}s)", False, retry_after=min(drains),
+                        f"{remaining:.2f}s)", False,
+                        retry_after=self._jitter_retry_after(min(drains)),
                         trace_id=tid,
                     )
             t_pick = time.perf_counter()
@@ -379,7 +590,8 @@ class Router:
                 _prof.record_router_event("no_replica")
                 _flight.record("admission", "no ready replica", trace_id=tid)
                 ra = self._clamp_retry_after(
-                    self.healthiest_retry_after(), deadline_t
+                    self._jitter_retry_after(self.healthiest_retry_after()),
+                    deadline_t,
                 )
                 return self._error(
                     503, "NoReadyReplica",
@@ -391,7 +603,7 @@ class Router:
                 if rep.rid != prev_rid:
                     _prof.record_router_event("failovers")
             outcome = self._send_hedged(rep, payload, remaining, trace,
-                                        attempt=attempt)
+                                        attempt=attempt, idem_key=idem_key)
             status, body, headers, retriable = outcome
             if status == 200:
                 return 200, body, headers
@@ -419,7 +631,8 @@ class Router:
             jitter = 0.5 + self._rng.random()
         return self.retry_backoff * (2 ** attempt) * jitter
 
-    def _send(self, rep, payload, remaining_s, trace, attempt=0):
+    def _send(self, rep, payload, remaining_s, trace, attempt=0,
+              idem_key=None):
         """One dispatch attempt.  Returns (status, body, headers, retriable)
         and folds the outcome into the replica's breaker/latency state.
 
@@ -432,7 +645,7 @@ class Router:
         t_fwd = time.perf_counter()
         try:
             status, body, headers, latency = rep.post_generate(
-                payload, remaining_s, trace=(tid, fwd_sid)
+                payload, remaining_s, trace=(tid, fwd_sid), idem_key=idem_key
             )
         except ReplicaTransportError as e:
             _obs.record(
@@ -476,19 +689,21 @@ class Router:
             rep.record_success(latency)
         return status, body, headers, retriable
 
-    def _send_hedged(self, rep, payload, remaining_s, trace, attempt=0):
+    def _send_hedged(self, rep, payload, remaining_s, trace, attempt=0,
+                     idem_key=None):
         """Dispatch with optional hedging: when the primary has not answered
         after `hedge_s`, duplicate the (zero-token, pure) request onto a
         second replica; the first complete response wins."""
         if self.hedge_s <= 0:
             return self._send(rep, payload, remaining_s, trace,
-                              attempt=attempt)
+                              attempt=attempt, idem_key=idem_key)
         results = []
         results_mu = threading.Lock()
         first_done = threading.Event()
 
         def _run(r):
-            out = self._send(r, payload, remaining_s, trace, attempt=attempt)
+            out = self._send(r, payload, remaining_s, trace, attempt=attempt,
+                             idem_key=idem_key)
             with results_mu:
                 results.append((out, r))
             first_done.set()
@@ -564,6 +779,18 @@ class Router:
 
     # -- helpers -------------------------------------------------------------
 
+    def _jitter_retry_after(self, ra):
+        """±FLAGS_router_retry_after_jitter fractional jitter on shed
+        Retry-After values: a takeover or brownout 503s many clients at
+        once, and un-jittered identical waits resynchronize them into a
+        thundering herd at the successor.  The float rides the body's
+        `retry_after_s`; the header still floors at 1s."""
+        if ra is None or self._retry_after_jitter <= 0:
+            return ra
+        with self._mu:
+            u = self._rng.random()
+        return max(0.0, ra * (1.0 + self._retry_after_jitter * (2.0 * u - 1.0)))
+
     @staticmethod
     def _clamp_retry_after(ra, deadline_t):
         """Never tell a client to retry after its own deadline."""
@@ -589,6 +816,128 @@ class Router:
             "retry_after_s": retry_after or 0,
             "trace_id": trace_id,
         }, headers
+
+
+class RouterStandby:
+    """Warm standby for the front door (the ISSUE 17 takeover state
+    machine): WATCHING — the primary's rank-0 heartbeat seq advances;
+    seq stalls for `FLAGS_router_takeover_timeout` on the STANDBY'S OWN
+    clock (the launch controller's stale-counter scheme — no cross-process
+    clock comparison) -> TAKING_OVER — replay the journal (repairing a
+    torn final segment), rebuild replica handles from the journaled
+    registry, restore breakers/drains/idempotency, synchronous probe
+    sweep -> SERVING — the successor Router answers traffic and beats the
+    same heartbeat slot.
+
+    Thread-safe: `primary_alive()` may be polled concurrently with the
+    optional `watch()` thread; every mutable field lives under `self._mu`.
+    """
+
+    def __init__(self, journal_root, heartbeat_root, replicas=(), *,
+                 timeout=None, poll_interval=0.05, make_router=None,
+                 router_kwargs=None):
+        self.journal_root = str(journal_root)
+        self.heartbeat_root = str(heartbeat_root)
+        self.timeout = float(
+            timeout if timeout is not None
+            else _core.flag("FLAGS_router_takeover_timeout"))
+        self.poll_interval = float(poll_interval)
+        self.replicas = list(replicas)
+        self.router_kwargs = dict(router_kwargs or {})
+        self._make_router = make_router
+        self._mu = threading.Lock()
+        self._last_seq = None
+        self._last_advance = None
+        self._router = None
+        self._watch_thread = None
+        self._stop = threading.Event()
+
+    @property
+    def router(self):
+        """The successor Router once takeover happened (else None)."""
+        with self._mu:
+            return self._router
+
+    def primary_alive(self, now=None):
+        """True while the primary's heartbeat seq keeps advancing, judged
+        on THIS process's monotonic clock.  The first observation arms the
+        staleness timer — a standby booted next to an already-dead primary
+        still waits one full timeout before declaring death."""
+        from ..fault import heartbeat as _hb
+
+        now = time.monotonic() if now is None else now
+        hb = _hb.scan_heartbeats(self.heartbeat_root).get(0)
+        seq = hb.get("seq") if isinstance(hb, dict) else None
+        with self._mu:
+            if self._last_advance is None:
+                self._last_advance = now
+                self._last_seq = seq
+                return True
+            if seq is not None and seq != self._last_seq:
+                self._last_seq = seq
+                self._last_advance = now
+                return True
+            return (now - self._last_advance) < self.timeout
+
+    def wait_for_death(self, timeout=60.0):
+        """Poll until the primary is declared dead; False on timeout or
+        stop()."""
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            if not self.primary_alive():
+                return True
+            if self._stop.wait(self.poll_interval):
+                return False
+        return False
+
+    def takeover(self):
+        """Become the front door: open the journal (replay + torn-tail
+        repair happen inside `Journal`), build the successor Router —
+        rehydration of registry/breakers/idempotency happens in its
+        constructor — and probe the fleet synchronously before any
+        traffic.  Returns the serving successor."""
+        journal = Journal(self.journal_root)
+        if self._make_router is not None:
+            router = self._make_router(journal)
+        else:
+            router = Router(
+                list(self.replicas), journal=journal,
+                heartbeat=self.heartbeat_root, **self.router_kwargs,
+            )
+        router.start()
+        with self._mu:
+            self._router = router
+        return router
+
+    def watch(self, on_takeover=None):
+        """Background supervision: poll the primary's heartbeat; on death,
+        take over and hand the successor to `on_takeover(router)`."""
+        with self._mu:
+            if self._watch_thread is not None:
+                return self
+
+        def _run():
+            while not self._stop.is_set():
+                if not self.primary_alive():
+                    router = self.takeover()
+                    if on_takeover is not None:
+                        on_takeover(router)
+                    return
+                if self._stop.wait(self.poll_interval):
+                    return
+
+        t = threading.Thread(target=_run, name="router-standby", daemon=True)
+        with self._mu:
+            self._watch_thread = t
+        t.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        with self._mu:
+            t = self._watch_thread
+        if t is not None:
+            t.join(5)
 
 
 def serve_router(replicas, port=8900, host="127.0.0.1", block=True, probe=True):
@@ -671,13 +1020,22 @@ def serve_router(replicas, port=8900, host="127.0.0.1", block=True, probe=True):
             # the router owns the deadline now: strip the absolute field so
             # replicas see only the remaining budget via X-Deadline-Ms
             payload.pop("deadline_s", None)
-            status, body, headers = router.handle_generate(
-                payload, deadline_ms=deadline_ms,
-                trace=_obs.ctx_from_headers(self.headers),
-            )
+            try:
+                status, body, headers = router.handle_generate(
+                    payload, deadline_ms=deadline_ms,
+                    idem_key=self.headers.get("X-Idempotency-Key"),
+                    trace=_obs.ctx_from_headers(self.headers),
+                )
+            except RouterCrashed:
+                # the front door is dead: drop the connection with no
+                # response bytes (the client sees a reset and resubmits
+                # its idempotency key against the successor)
+                self.close_connection = True
+                return
             self._reply(status, body, headers={
                 k: v for k, v in headers.items()
-                if k.lower() in ("retry-after", "x-trace-id")
+                if k.lower() in ("retry-after", "x-trace-id",
+                                 "x-idempotency-replay")
             })
 
     server = ThreadingHTTPServer((host, port), Handler)

@@ -271,10 +271,14 @@ class SoakReport:
 
 
 def run_soak(router, workload, *, threads=8, faults=(), realtime=True,
-             queue_bound=4096, on_progress=None):
+             queue_bound=4096, on_progress=None, crash_retries=100,
+             crash_retry_s=0.05):
     """Drive `workload` through `router.handle_generate` with a bounded
     worker pool.  Returns a closed `SoakReport`.
 
+    router     a live `Router`, or a zero-arg callable returning the
+               CURRENT router (HA soaks pass a provider so workers pick
+               up the standby's successor after a `router.crash` drill)
     faults     ((t_s, spec), ...): each `spec` is armed through
                `fault.injection.arm` when the arrival clock first passes
                t_s — the SAME registry and grammar production uses, so a
@@ -284,11 +288,22 @@ def run_soak(router, workload, *, threads=8, faults=(), realtime=True,
                (throughput / million-request capability runs)
     on_progress  optional callable(report, t) invoked about once per
                arrival-clock second (progress logging in long soaks)
+    crash_retries / crash_retry_s  resubmit budget when the front door
+               dies mid-request (`RouterCrashed`): the worker re-attaches
+               the SAME idempotency key and resubmits against whatever
+               the provider returns, so a takeover window never breaks
+               the exactly-once audit
+
+    Every request carries a deterministic idempotency key
+    (``soak-<seed>-<n>``), so a resubmit after a router crash joins or
+    replays the original generation instead of double-generating.
     """
     import queue as _q
 
     from ..fault import injection as _finj
+    from .router import RouterCrashed
 
+    get_router = router if callable(router) else (lambda: router)
     report = SoakReport()
     work = _q.Queue(maxsize=queue_bound)
     done = threading.Event()
@@ -300,11 +315,25 @@ def run_soak(router, workload, *, threads=8, faults=(), realtime=True,
             if item is None:
                 return
             kind, req = item
+            key = req["payload"].get("idempotency_key")
             t0 = time.monotonic()
             try:
-                status, body, _hdrs = router.handle_generate(
-                    req["payload"], deadline_ms=req["deadline_ms"]
-                )
+                for attempt in range(int(crash_retries) + 1):
+                    try:
+                        status, body, _hdrs = get_router().handle_generate(
+                            req["payload"], deadline_ms=req["deadline_ms"]
+                        )
+                        break
+                    except RouterCrashed:
+                        # The front door died with zero response bytes on
+                        # the wire; resubmitting the SAME key against the
+                        # successor is the ISSUE 17 exactly-once drill.
+                        # handle_generate pops the key, so re-attach it.
+                        if attempt >= crash_retries:
+                            raise
+                        if key is not None:
+                            req["payload"]["idempotency_key"] = key
+                        time.sleep(crash_retry_s)
             except Exception as e:  # a raising router is a broken contract:
                 status, body = -1, {"type": type(e).__name__}  # count it loud
             with mu:
@@ -323,6 +352,12 @@ def run_soak(router, workload, *, threads=8, faults=(), realtime=True,
     last_progress = 0.0
     try:
         for t_arr, kind, req in workload.arrivals():
+            # Deterministic per-request idempotency key: replayable from
+            # the seed, unique per offered request, honoured by the
+            # router's dedupe cache (a crash-window resubmit reuses it).
+            req["payload"].setdefault(
+                "idempotency_key", f"soak-{workload.seed}-{report.offered}"
+            )
             while fi < len(fault_sched) and fault_sched[fi][0] <= t_arr:
                 spec = fault_sched[fi][1]
                 _finj.arm(spec)

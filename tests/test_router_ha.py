@@ -1,0 +1,726 @@
+"""Crash-proof front door (ISSUE 17): durable control-plane journal,
+idempotent requests, and supervised router failover.
+
+Fast tests exercise the journal's crash signatures deterministically
+(torn final tail repaired in place, interior corruption refused,
+compaction bit-for-bit), the idempotency cache's three verdicts
+(double-submit replay, in-flight join, retriable-never-cached), successor
+rehydration (breakers stay open, cached responses replay with ZERO
+replicas, autoscaler cooldown clocks survive), and the standby's
+stale-counter death detection.  The slow drill kills the router itself
+(`router.crash`) mid-soak and proves the warm standby resumes serving
+exactly-once with bit-identical tokens.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler as prof
+from paddle_tpu.fault import injection as finj
+from paddle_tpu.fault.heartbeat import HeartbeatWriter
+from paddle_tpu.inference import serve
+from paddle_tpu.inference.engine import ContinuousBatchingEngine
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (
+    IdempotencyCache,
+    Journal,
+    JournalCorruption,
+    Replica,
+    Router,
+    RouterStandby,
+    Workload,
+    run_soak,
+    serve_router,
+)
+from paddle_tpu.serving import journal as jmod
+from paddle_tpu.serving.autoscaler import Autoscaler, decide, load_signals
+
+
+@pytest.fixture(scope="module")
+def model():
+    np.random.seed(1234)
+    return LlamaForCausalLM(LlamaConfig.tiny())
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    prof.reset_router()
+    yield
+    finj.disarm()
+    prof.reset_router()
+
+
+def _prompt(n, seed=0):
+    return np.random.RandomState(seed).randint(1, 250, size=n).astype(np.int32)
+
+
+def _ref(model, p, n):
+    return model.generate(paddle.to_tensor(p[None]), max_new_tokens=n).numpy()[0]
+
+
+def _replica_server(model, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prefill_buckets", [8])
+    kw.setdefault("queue_depth", 16)
+    kw.setdefault("seed", 0)
+    eng = ContinuousBatchingEngine(model, **kw)
+    srv = serve(eng, port=0, block=False, supervise=False, handle_signals=False)
+    return srv, eng, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def _stop_server(srv):
+    try:
+        srv.engine.stop()
+    except Exception:
+        pass
+    srv.shutdown()
+    srv.server_close()
+
+
+def _post(url, body, headers=None, timeout=60):
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _state_key(state):
+    """Canonical bytes for bit-for-bit state comparison (seq excluded:
+    compaction itself consumes one)."""
+    st = dict(state)
+    st.pop("seq", None)
+    return json.dumps(st, sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# the journal: append/replay, torn tail, interior corruption, compaction
+# ---------------------------------------------------------------------------
+
+
+def test_journal_append_replay_roundtrip(tmp_path):
+    root = str(tmp_path / "j")
+    j = Journal(root)
+    assert not j.resumed
+    j.append("replica", op="register", rid="r0", url="http://a")
+    j.append("breaker", rid="r0", state="open", fails=3,
+             open_until_wall=time.time() + 30)
+    j.append("replica", op="drain", rid="r0", draining=True)
+    j.append("autoscale", band=[1, 3], last_action_wall=time.time(),
+             up_streak=0, down_streak=1)
+    j.append("idem_done", key="k1", status=200, body={"tokens": [1, 2]})
+    j.close()
+
+    state, stats = jmod.replay(root)
+    assert stats == {"records": 5, "torn": 0}
+    assert state["replicas"]["r0"] == {"url": "http://a", "draining": True}
+    assert state["breakers"]["r0"]["breaker"] == "open"
+    assert state["breakers"]["r0"]["fails"] == 3
+    assert state["autoscale"]["band"] == [1, 3]
+    assert state["idem"]["k1"]["body"] == {"tokens": [1, 2]}
+
+    j2 = Journal(root)  # a successor's open: resumed, seq continues
+    assert j2.resumed and j2.seq == 5
+    assert j2.append("takeover") == 6
+    assert j2.state_snapshot()["takeovers"] == 1
+    j2.close()
+
+
+def test_journal_torn_final_tail_repaired_in_place(tmp_path):
+    root = str(tmp_path / "j")
+    j = Journal(root)
+    for i in range(4):
+        j.append("replica", op="register", rid=f"r{i}", url="u")
+    j.close()
+    # SIGKILL mid-write: the final segment ends in half a record
+    seg = sorted((tmp_path / "j").glob("journal-*.seg"))[-1]
+    raw = seg.read_bytes()
+    seg.write_bytes(raw[:-7] + b'{"torn')
+
+    j2 = Journal(root)  # torn tail: last record dropped, file repaired
+    assert j2.stats()["torn_records"] == 1
+    assert set(j2.state_snapshot()["replicas"]) == {"r0", "r1", "r2"}
+    assert prof.router_summary()["journal_torn_records"] >= 1
+    j2.close()
+
+    state, stats = jmod.replay(root)  # the repair held on disk
+    assert stats == {"records": 3, "torn": 0}
+    assert set(state["replicas"]) == {"r0", "r1", "r2"}
+
+
+def test_journal_interior_corruption_refused(tmp_path):
+    root = str(tmp_path / "j")
+    j = Journal(root, segment_records=2)
+    for i in range(5):  # 3 segments: [1,2] [3,4] [5]
+        j.append("replica", op="register", rid=f"r{i}", url="u")
+    j.close()
+    segs = sorted((tmp_path / "j").glob("journal-*.seg"))
+    assert len(segs) == 3
+    lines = segs[0].read_text().splitlines(keepends=True)
+    segs[0].write_text("corrupted-beyond-recognition\n" + lines[1])
+    with pytest.raises(JournalCorruption):
+        jmod.replay(root)
+    with pytest.raises(JournalCorruption):  # Journal refuses to open too
+        Journal(root)
+
+
+def test_journal_compaction_bit_for_bit(tmp_path):
+    root = str(tmp_path / "j")
+    j = Journal(root, segment_records=3)
+    now = time.time()
+    for i in range(4):
+        j.append("replica", op="register", rid=f"r{i}", url=f"u{i}")
+    j.append("replica", op="deregister", rid="r3")
+    j.append("breaker", rid="r1", state="open", fails=2,
+             open_until_wall=now + 60)
+    j.append("idem_done", key="fresh", status=200, body={"tokens": [7]})
+    j.append("idem_admit", key="live")
+    before = jmod.replay(root)[0]
+
+    j.compact(now=now)
+    after_live = j.state_snapshot()
+    after_disk = jmod.replay(root)[0]
+    assert _state_key(before) == _state_key(after_live) == _state_key(after_disk)
+    assert len(list((tmp_path / "j").glob("journal-*.seg"))) == 1
+    assert j.stats()["compactions"] == 1
+
+    # appends continue after the snapshot and fold on top of it
+    j.append("takeover")
+    assert jmod.replay(root)[0]["takeovers"] == 1
+    j.close()
+
+
+def test_journal_compaction_prunes_expired_idempotency(tmp_path):
+    j = Journal(str(tmp_path / "j"), ttl_s=10.0)
+    j.append("idem_done", key="old", status=200, body={})
+    j.append("idem_done", key="new", status=200, body={})
+    st = j.state_snapshot()
+    j.compact(now=st["idem"]["old"]["t"] + 600.0)  # both written "now"; both expire
+    assert j.state_snapshot()["idem"] == {}
+    j.close()
+
+
+# ---------------------------------------------------------------------------
+# the idempotency cache: double submit, in-flight join, retriable-never-cached
+# ---------------------------------------------------------------------------
+
+
+def test_idem_cache_three_verdicts():
+    c = IdempotencyCache(ttl_s=60.0)
+    verdict, _ = c.begin("k")
+    assert verdict == "new"
+    verdict, entry = c.begin("k")  # resubmit DURING: joins the live request
+    assert verdict == "join"
+    assert c.complete("k", 200, {"tokens": [1]}, {"X-Trace-Id": "t"})
+    assert c.wait(entry, timeout=1.0) == (200, {"tokens": [1]},
+                                          {"X-Trace-Id": "t"})
+    verdict, resp = c.begin("k")  # resubmit AFTER: replays
+    assert verdict == "done" and resp[0] == 200
+    assert c.stats() == {"cached": 1, "inflight": 0}
+
+
+def test_idem_cache_never_caches_retriable_outcomes():
+    c = IdempotencyCache(ttl_s=60.0)
+    c.begin("k")
+    assert not c.complete("k", 503, {"retriable": True, "type": "Shed"})
+    assert c.begin("k")[0] == "new"  # the retry re-executes
+    # a non-retriable typed error IS terminal and replays
+    assert c.complete("k", 404, {"retriable": False, "type": "AdapterUnknown"})
+    assert c.begin("k")[0] == "done"
+
+
+def test_idem_cache_abandon_wakes_joiners_empty():
+    c = IdempotencyCache(ttl_s=60.0)
+    c.begin("k")
+    _, entry = c.begin("k")
+    got = []
+    t = threading.Thread(target=lambda: got.append(c.wait(entry, timeout=5.0)))
+    t.start()
+    c.abandon("k")  # the live request died without a response
+    t.join(5.0)
+    assert got == [None]
+    assert c.begin("k")[0] == "new"
+
+
+def test_idem_cache_ttl_expiry():
+    c = IdempotencyCache(ttl_s=5.0)
+    c.begin("k", now=1000.0)
+    c.complete("k", 200, {"tokens": [1]}, now=1000.0)
+    assert c.begin("k", now=1004.0)[0] == "done"
+    assert c.begin("k", now=1006.0)[0] == "new"  # expired: executes again
+
+
+# ---------------------------------------------------------------------------
+# the router front door: dedupe end to end, healthz, jitter
+# ---------------------------------------------------------------------------
+
+
+def test_router_double_submit_one_generation(model):
+    srv, eng, url = _replica_server(model)
+    router = Router([Replica("r0", url)], probe_interval=60.0)
+    calls = []
+    rep = router.replicas[0]
+    orig = rep.post_generate
+    rep.post_generate = lambda *a, **k: calls.append(1) or orig(*a, **k)
+    try:
+        router.probe_once()
+        p = _prompt(6, seed=2)
+        payload = {"input_ids": p.tolist(), "max_new_tokens": 4,
+                   "temperature": 0.0}
+        s1, b1, h1 = router.handle_generate(dict(payload), idem_key="dup-1")
+        s2, b2, h2 = router.handle_generate(dict(payload), idem_key="dup-1")
+        # body-carried key works too, and is stripped before forwarding
+        s3, b3, h3 = router.handle_generate(
+            {**payload, "idempotency_key": "dup-1"}
+        )
+        assert s1 == s2 == s3 == 200
+        assert json.dumps(b1) == json.dumps(b2) == json.dumps(b3)
+        assert np.array_equal(b1["tokens"], _ref(model, p, 4))
+        assert h2["X-Idempotency-Replay"] == "hit"
+        assert h3["X-Idempotency-Replay"] == "hit"
+        assert len(calls) == 1  # exactly one generation hit the fleet
+        assert prof.router_summary()["idem_hits"] == 2
+    finally:
+        router.stop()
+        _stop_server(srv)
+
+
+def test_router_inflight_join_returns_identical_bytes(model):
+    srv, eng, url = _replica_server(model)
+    router = Router([Replica("r0", url)], probe_interval=60.0)
+    rep = router.replicas[0]
+    entered, release = threading.Event(), threading.Event()
+    orig = rep.post_generate
+    calls = []
+
+    def _gated(*a, **k):
+        calls.append(1)
+        entered.set()
+        assert release.wait(10.0)
+        return orig(*a, **k)
+
+    rep.post_generate = _gated
+    try:
+        router.probe_once()
+        p = _prompt(5, seed=4)
+        payload = {"input_ids": p.tolist(), "max_new_tokens": 4,
+                   "temperature": 0.0}
+        out = {}
+
+        def _submit(tag):
+            out[tag] = router.handle_generate(dict(payload), idem_key="join-1")
+
+        t1 = threading.Thread(target=_submit, args=("first",))
+        t1.start()
+        assert entered.wait(10.0)
+        t2 = threading.Thread(target=_submit, args=("second",))
+        t2.start()
+        time.sleep(0.1)  # the second submit is parked on the join
+        release.set()
+        t1.join(30.0)
+        t2.join(30.0)
+        s1, b1, _ = out["first"]
+        s2, b2, h2 = out["second"]
+        assert s1 == s2 == 200
+        assert json.dumps(b1) == json.dumps(b2)
+        assert h2["X-Idempotency-Replay"] == "join"
+        assert len(calls) == 1
+        assert prof.router_summary()["idem_joins"] == 1
+    finally:
+        router.stop()
+        _stop_server(srv)
+
+
+def test_serve_side_dedupe_replays_on_retry(model):
+    """The replica's own front door dedupes too: a client whose connection
+    reset AFTER the replica finished replays the exact bytes on resubmit
+    (this is what makes a router-crash resubmit exactly-once end to end)."""
+    srv, eng, url = _replica_server(model)
+    try:
+        p = _prompt(6, seed=5)
+        body = {"input_ids": p.tolist(), "max_new_tokens": 4,
+                "temperature": 0.0}
+        s1, b1, _ = _post(url, body, headers={"X-Idempotency-Key": "c-1"})
+        s2, b2, h2 = _post(url, body, headers={"X-Idempotency-Key": "c-1"})
+        assert s1 == s2 == 200
+        assert json.dumps(b1) == json.dumps(b2)
+        assert h2.get("X-Idempotency-Replay") == "hit"
+        assert np.array_equal(b1["tokens"], _ref(model, p, 4))
+    finally:
+        _stop_server(srv)
+
+
+def test_healthz_reports_front_door_state(model, tmp_path):
+    srv, eng, url = _replica_server(model)
+    router = Router([Replica("r0", url)], probe_interval=60.0,
+                    journal=str(tmp_path / "j"))
+    front = serve_router(router, port=0, probe=False, block=False)
+    try:
+        router.probe_once()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{front.server_address[1]}/healthz", timeout=5
+        ) as r:
+            h = json.loads(r.read())
+        assert h["ready_replicas"] == 1
+        assert h["breakers"] == {"r0": "closed"}
+        assert h["takeovers"] == 0
+        assert h["journal_seq"] >= 1  # the registration record
+        assert h["idempotency"] == {"cached": 0, "inflight": 0}
+    finally:
+        front.stop_router()
+        front.server_close()
+        _stop_server(srv)
+
+
+def test_retry_after_jitter_spread():
+    router = Router([], probe_interval=60.0, seed=7)
+    draws = [router._jitter_retry_after(10.0) for _ in range(300)]
+    assert all(7.5 - 1e-9 <= d <= 12.5 + 1e-9 for d in draws)
+    assert min(draws) < 9.0 and max(draws) > 11.0  # actually spread
+    assert len(set(draws)) > 100  # not resynchronizing the herd
+    router._retry_after_jitter = 0.0
+    assert router._jitter_retry_after(10.0) == 10.0
+    assert router._jitter_retry_after(None) is None
+
+
+def test_shed_retry_after_is_jittered(model):
+    """With no ready replica the typed 503 carries a jittered retry_after_s
+    (±25% around the base drain estimate) while the header keeps its 1s
+    integer floor."""
+    router = Router([Replica("r0", "http://127.0.0.1:9")],
+                    probe_interval=60.0, seed=3)
+    ras = set()
+    for _ in range(20):
+        status, body, headers = router.handle_generate(
+            {"input_ids": [1], "max_new_tokens": 1}
+        )
+        assert status == 503 and body["type"] == "NoReadyReplica"
+        assert body["retriable"] is True
+        assert 0.75 <= body["retry_after_s"] <= 1.25
+        assert headers["Retry-After"].isdigit()
+        ras.add(body["retry_after_s"])
+    assert len(ras) > 1
+
+
+# ---------------------------------------------------------------------------
+# successor rehydration: breakers, drains, cached responses, cooldown clocks
+# ---------------------------------------------------------------------------
+
+
+def test_successor_restores_breakers_and_drains(tmp_path):
+    j_root = str(tmp_path / "j")
+    rep = Replica("r0", "http://127.0.0.1:9", breaker_threshold=2,
+                  breaker_cooldown=30.0)
+    primary = Router([rep], probe_interval=60.0, journal=j_root)
+    rep.record_failure("sick")
+    rep.record_failure("sick")  # trips the breaker: journaled transition
+    rep.set_admin_draining(True)
+    assert rep.breaker == "open"
+    primary.journal.close()  # kill -9: no graceful handoff beyond this
+
+    successor = Router([], probe_interval=60.0, journal=j_root)
+    try:
+        reps = {r.rid: r for r in successor.replicas}
+        assert set(reps) == {"r0"}  # registry rebuilt from the journal
+        assert reps["r0"].base_url == "http://127.0.0.1:9"
+        # the successor does NOT re-close onto the sick replica: the
+        # breaker comes back open with the primary's cooldown still binding
+        assert reps["r0"].breaker == "open"
+        assert not reps["r0"].allow()
+        assert reps["r0"].snapshot()["admin_draining"] is True
+        h = successor.healthz()
+        assert h["takeovers"] == 1
+        assert prof.router_summary()["takeovers"] == 1
+
+        third = Router([], probe_interval=60.0, journal=j_root)
+        assert third.healthz()["takeovers"] == 2  # takeovers accumulate
+        third.journal.close()
+    finally:
+        successor.stop()
+
+
+def test_successor_replays_completed_keys_with_zero_replicas(tmp_path):
+    j_root = str(tmp_path / "j")
+    primary = Router([Replica("r0", "http://127.0.0.1:9")],
+                     probe_interval=60.0, journal=j_root)
+    primary._idem.complete("done-key", 200, {"tokens": [1, 2, 3]},
+                           {"X-Trace-Id": "t0"})
+    primary.journal.close()
+
+    successor = Router([], probe_interval=60.0, journal=j_root)
+    try:
+        # no replica is even reachable — the journaled response replays
+        status, body, headers = successor.handle_generate(
+            {"input_ids": [1], "max_new_tokens": 3}, idem_key="done-key"
+        )
+        assert status == 200
+        assert body == {"tokens": [1, 2, 3]}
+        assert headers["X-Idempotency-Replay"] == "hit"
+    finally:
+        successor.stop()
+
+
+def test_autoscaler_cooldown_clock_survives_takeover(tmp_path):
+    j_root = str(tmp_path / "j")
+    j1 = Journal(j_root)
+    j1.append("autoscale", band=[1, 3], last_action_wall=time.time() - 5.0,
+              up_streak=0, down_streak=2)
+    j1.close()
+
+    j2 = Journal(j_root)
+    assert j2.resumed
+    asc = Autoscaler(
+        Router([], probe_interval=60.0), spawn_fn=lambda i, tp: None,
+        stop_fn=lambda r: None, min_replicas=1, max_replicas=3,
+        interval=999.0, tp_max=1, devices_total=1, drain_grace=1.0,
+        journal=j2,
+    )
+    # ~5s of the primary's cooldown already elapsed on THIS clock
+    elapsed = time.monotonic() - asc._last_action_t
+    assert 4.0 <= elapsed <= 7.0
+    assert asc._down_streak == 2
+    j2.close()
+
+
+# ---------------------------------------------------------------------------
+# the autoscaler cost signal (satellite: ROADMAP item 3)
+# ---------------------------------------------------------------------------
+
+
+def _idle_snap(rid, ewma_ms=10.0, tps=2.0):
+    return {
+        "id": rid, "state": "ready", "admin_draining": False,
+        "queue_depth": 0, "active_slots": 0, "drain_estimate_s": 0.0,
+        "deadline_miss_rate": 0.0, "page_free_frac": 1.0,
+        "decode_ewma_ms": ewma_ms, "tokens_per_step": tps,
+    }
+
+
+def test_idle_tokens_cost_signal_and_down_gate():
+    snaps = [_idle_snap("a"), _idle_snap("b", ewma_ms=20.0, tps=3.0)]
+    sig = load_signals(snaps)
+    # 2.0 * (1e3/10) + 3.0 * (1e3/20) = 200 + 150
+    assert sig["idle_tokens_per_s"] == pytest.approx(350.0)
+    # a busy replica contributes nothing reclaimable
+    busy = dict(_idle_snap("c"), active_slots=1)
+    assert load_signals([busy])["idle_tokens_per_s"] == 0.0
+
+    cfg = {
+        "min_replicas": 1, "max_replicas": 4, "up_drain_s": 9e9,
+        "up_queue_depth": 9e9, "up_miss_rate": 1.0, "min_page_free": 0.0,
+        "down_drain_s": 1.0, "down_min_idle_tokens_s": 0.0, "chips": 2,
+    }
+    want, reason = decide(sig, cfg)
+    assert want == "down"
+    assert "idle" in reason
+    assert "reclaim 175.0 idle tokens/s/chip" in reason  # 350 / 2 chips
+    # the $/token floor: below it, emptiness alone does not shrink
+    want, reason = decide(sig, {**cfg, "down_min_idle_tokens_s": 1e9})
+    assert want == "hold"
+
+
+# ---------------------------------------------------------------------------
+# the soak driver: deterministic keys, crash resubmission
+# ---------------------------------------------------------------------------
+
+
+def test_soak_attaches_deterministic_idempotency_keys():
+    seen = []
+
+    class _Fake:
+        def handle_generate(self, payload, deadline_ms=None):
+            seen.append(payload.get("idempotency_key"))
+            return 200, {"tokens": []}, {}
+
+    wl = Workload(rate_hz=200.0, duration_s=5.0, seed=7, requests=20)
+    report = run_soak(_Fake(), wl, threads=2, realtime=False)
+    assert report.exactly_once and report.offered == 20
+    assert len(seen) == 20 and len(set(seen)) == 20
+    assert all(k.startswith("soak-7-") for k in seen)
+    # same seed -> same keys: a soak's retry schedule is replayable
+    seen2, seen[:] = list(seen), []
+    run_soak(_Fake(), wl, threads=2, realtime=False)
+    assert sorted(seen) == sorted(seen2)
+
+
+def test_soak_resubmits_same_key_through_a_crash():
+    from paddle_tpu.serving.router import RouterCrashed
+
+    calls = []
+
+    class _Crashy:
+        def __init__(self):
+            self.crashes_left = 2
+
+        def handle_generate(self, payload, deadline_ms=None):
+            key = payload.pop("idempotency_key", None)  # the real router pops
+            calls.append(key)
+            if self.crashes_left > 0:
+                self.crashes_left -= 1
+                raise RouterCrashed("drill")
+            return 200, {"tokens": []}, {}
+
+    wl = Workload(rate_hz=200.0, duration_s=5.0, seed=1, requests=1)
+    report = run_soak(_Crashy(), wl, threads=1, realtime=False,
+                      crash_retry_s=0.0)
+    assert report.exactly_once
+    assert report.status_counts == {200: 1}
+    assert len(calls) == 3  # two crashes + the success
+    assert len(set(calls)) == 1  # every attempt carried the SAME key
+
+
+# ---------------------------------------------------------------------------
+# the standby: stale-counter death detection and takeover
+# ---------------------------------------------------------------------------
+
+
+def test_standby_detects_stale_heartbeat_and_takes_over(tmp_path):
+    j_root, hb_root = str(tmp_path / "j"), str(tmp_path / "hb")
+    seed = Journal(j_root)
+    seed.append("replica", op="register", rid="r0", url="http://127.0.0.1:9")
+    seed.close()
+
+    class _Dummy:
+        def __init__(self, journal):
+            self.journal = journal
+
+        def start(self):
+            return self
+
+    writer = HeartbeatWriter(hb_root, rank=0, interval=0.0)
+    standby = RouterStandby(j_root, hb_root, timeout=0.4, poll_interval=0.02,
+                            make_router=_Dummy)
+    try:
+        assert standby.primary_alive()  # first observation arms the timer
+        for _ in range(3):  # the primary keeps beating: stays alive
+            time.sleep(0.15)
+            writer.beat()
+            assert standby.primary_alive()
+        writer.stop()  # kill -9: the seq counter stops advancing
+        t0 = time.monotonic()
+        assert standby.wait_for_death(timeout=5.0)
+        assert time.monotonic() - t0 >= 0.3  # one full timeout, OWN clock
+        successor = standby.takeover()
+        assert standby.router is successor
+        assert successor.journal.resumed
+        assert "r0" in successor.journal.state_snapshot()["replicas"]
+    finally:
+        standby.stop()
+        writer.stop()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill: kill -9 the ROUTER mid-soak, standby resumes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_soak_router_kill9_ha(model, tmp_path, monkeypatch):
+    """The ISSUE 17 acceptance drill: a 2-replica fleet behind a journaled,
+    heartbeating router; `router.crash` fires mid-soak (kill -9 of the
+    front door — in-flight callers see RouterCrashed where HTTP clients
+    would see a reset); the warm standby detects the stale heartbeat on
+    its own clock, replays the journal, re-probes the fleet, and resumes.
+    Every request resolves exactly once, outcomes stay typed, and the
+    successor serves bit-identical greedy tokens."""
+    import os
+    import pathlib
+
+    from paddle_tpu.obs import flight
+
+    # honor a CI-provided dump dir (ci.sh chaos-router-ha asserts on it)
+    obs_dir = pathlib.Path(
+        os.environ.get("PADDLE_OBS_DIR") or str(tmp_path / "flightrec")
+    )
+    monkeypatch.setenv("PADDLE_OBS_DIR", str(obs_dir))
+    flight.reset()
+    j_root = str(tmp_path / "journal")
+    hb_root = str(tmp_path / "hb")
+
+    srv_a, eng_a, url_a = _replica_server(model)
+    srv_b, eng_b, url_b = _replica_server(model, seed=1)
+    current, routers = {}, []
+    standby = None
+    try:
+        primary = Router(
+            [Replica("a", url_a), Replica("b", url_b)],
+            probe_interval=0.1, retry_backoff=0.05,
+            journal=j_root, heartbeat=hb_root,
+        ).start()
+        current["router"] = primary
+        routers.append(primary)
+        assert primary.healthz()["ready_replicas"] == 2
+
+        takeover_done = threading.Event()
+
+        def _on_takeover(r):
+            current["router"] = r
+            routers.append(r)
+            takeover_done.set()
+
+        standby = RouterStandby(
+            j_root, hb_root, timeout=0.5, poll_interval=0.02,
+            router_kwargs={"probe_interval": 0.1, "retry_backoff": 0.05},
+        ).watch(on_takeover=_on_takeover)
+
+        wl = Workload(rate_hz=25.0, duration_s=4.0, seed=17,
+                      prompt_len=(4, 8), max_new_tokens=4)
+        report = run_soak(
+            lambda: current["router"], wl, threads=6,
+            faults=((1.0, "router.crash:1"),),
+        )
+
+        assert takeover_done.wait(10.0), "standby never took over"
+        successor = current["router"]
+        assert successor is not primary
+
+        # exactly-once through the kill: every offered request resolved
+        # exactly once, nothing raised out of the workers, nothing landed
+        # outside the typed contract
+        assert report.exactly_once
+        assert -1 not in report.status_counts
+        assert report.kind_counts["ok"]["unexpected"] == 0
+        assert report.status_counts.get(200, 0) > 0
+
+        h = successor.healthz()
+        assert h["takeovers"] == 1
+        assert h["ready_replicas"] == 2
+        assert h["journal_seq"] > 0
+        assert prof.router_summary()["crashes"] == 1
+
+        # bit-identity through the successor, and the resubmit contract:
+        # the same key replays the exact bytes without re-generating
+        p = _prompt(6, seed=3)
+        payload = {"input_ids": p.tolist(), "max_new_tokens": 4,
+                   "temperature": 0.0}
+        s1, b1, _ = successor.handle_generate(dict(payload), idem_key="ha-fin")
+        s2, b2, h2 = successor.handle_generate(dict(payload), idem_key="ha-fin")
+        assert s1 == s2 == 200
+        assert json.dumps(b1) == json.dumps(b2)
+        assert np.array_equal(b1["tokens"], _ref(model, p, 4))
+        assert h2["X-Idempotency-Replay"] == "hit"
+
+        # the crash dumped the flight ring for post-mortem
+        assert list(obs_dir.glob("flight-*.jsonl"))
+    finally:
+        if standby is not None:
+            standby.stop()
+        for r in routers:
+            try:
+                r.stop()
+            except Exception:
+                pass
+        _stop_server(srv_a)
+        _stop_server(srv_b)

@@ -268,6 +268,21 @@ FUSED_TESTS=(tests/test_fused_paged_attention.py::TestEngineFused::test_mixed_tr
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python -m pytest "${FUSED_TESTS[@]}" -q -p no:cacheprovider
 
+echo "== quantized KV serving smoke (ISSUE 18 acceptance subset) =="
+# both tiers: the int8 page arena (quantize-on-write scatters, in-VMEM
+# dequant in the fused kernel — CPU: interpret mode) matches the quantized
+# gather oracle token-for-token with zero recompiles after warmup, and the
+# mixed ragged replay holds the >= 0.95 token-match bar vs the full-
+# precision engine; fast mode runs that pair, full mode the whole file
+# (COW scale isolation, prefix-hit bit-reproducibility, spec + LoRA
+# co-batch quality, warm-restart survival, pool auto-sizing, cache-key
+# salting, /metrics + /healthz + flight surfaces)
+KVQ_TESTS=(tests/test_kv_quant.py::TestQuantEngine::test_zero_recompiles_and_fused_token_identity
+           tests/test_kv_quant.py::TestQuantEngine::test_tokens_match_full_precision)
+[ "$MODE" != "fast" ] && KVQ_TESTS=(tests/test_kv_quant.py)
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python -m pytest "${KVQ_TESTS[@]}" -q -p no:cacheprovider
+
 echo "== tensor-parallel smoke (ISSUE 14 acceptance subset) =="
 # both tiers, pinned to the 8-device CPU-sim mesh: the TP=4 engine (column/
 # row-sharded projections, mesh-sharded KV arena + decode kernel, all in the

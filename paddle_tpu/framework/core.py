@@ -518,6 +518,17 @@ define_flag(
     "the fused kernel is parity-tested against)",
 )
 define_flag(
+    "FLAGS_serve_kv_quant", "none",
+    "paged engine: KV-cache storage precision — 'none' stores pages in the "
+    "model's cache dtype; 'int8' stores K/V pages as int8 with per-token-"
+    "row, per-kv-head float32 scales in a parallel scale arena that rides "
+    "the same page tables/refcounts/COW/prefix machinery, roughly doubling "
+    "the page pool the same HBM budget buys (FLAGS_serve_kv_pool_pages "
+    "auto-sizing accounts for the scale bytes).  The fused Pallas decode "
+    "kernel dequantizes per page tile in VMEM; the gather oracle applies "
+    "the same dequant math",
+)
+define_flag(
     "FLAGS_serve_tp", 1,
     "tensor-parallel serving: shard the model's column/row-parallel "
     "projections, the paged KV arena (kv_heads axis), and the fused "

@@ -123,9 +123,13 @@ from ..tensor import Tensor
 from .paging import (
     PagePool,
     PrefixCache,
+    QuantConfigError,
+    check_scale_arenas,
     check_table_bounds,
+    kv_page_bytes,
     shard_kv_for_tp,
     spec_write_pages,
+    validate_kv_quant,
 )
 from .spec import NgramDrafter
 
@@ -293,7 +297,7 @@ class ContinuousBatchingEngine:
     def __init__(self, model, slots=None, max_len=None, prefill_buckets=None,
                  queue_depth=None, seed=0, paged=None, page_size=None,
                  pool_pages=None, prefix_cache=None, spec_k=None, lora=None,
-                 decode_kernel=None, tp=None):
+                 decode_kernel=None, tp=None, kv_quant=None):
         import jax
 
         from .. import jit, to_tensor
@@ -362,6 +366,17 @@ class ContinuousBatchingEngine:
         self.paged = bool(
             _fcore.flag("FLAGS_serve_paged_kv") if paged is None else paged
         )
+        # quantized KV serving (ISSUE 18): validated HERE — typed
+        # QuantConfigError at construction, never a dtype mismatch inside a
+        # compiled step — and folded into every cache-key surface: the
+        # arenas' int8/scale avals, the paged_flash_decode closure, and the
+        # FLAGS_serve_kv_quant entries in ops.dispatch._dispatch_salt and
+        # the AOT snapshot fingerprint
+        self.kv_quant = validate_kv_quant(
+            _fcore.flag("FLAGS_serve_kv_quant") if kv_quant is None
+            else kv_quant,
+            paged=self.paged,
+        )
         if self.paged:
             ps = int(
                 page_size if page_size is not None
@@ -396,18 +411,56 @@ class ContinuousBatchingEngine:
                 pool_pages if pool_pages is not None
                 else _fcore.flag("FLAGS_serve_kv_pool_pages")
             )
+            cache_dtype_bytes = int(
+                np.dtype(_fcore.to_jax_dtype(cache_dtype)).itemsize
+            )
             if pp <= 0:  # auto: every slot can hold a max_len sequence
                 pp = self.slots * self.pages_per_seq + 1
+                if self.kv_quant == "int8":
+                    # same HBM budget, more pages: the auto pool holds the
+                    # BYTES of the full-precision pool, so the int8 arena's
+                    # page count scales by full_page_bytes / (int8 page +
+                    # its scale rows) — ~1.94x at bf16 head_dim=128.  Scale
+                    # bytes are charged here, not hidden: the ratio uses
+                    # kv_page_bytes which counts the 4-byte f32 scale per
+                    # (row, kv head)
+                    full = kv_page_bytes(
+                        self.page_size, cfg.num_key_value_heads, head_dim,
+                        cache_dtype_bytes, "none",
+                    )
+                    q8 = kv_page_bytes(
+                        self.page_size, cfg.num_key_value_heads, head_dim,
+                        cache_dtype_bytes, "int8",
+                    )
+                    pp = (self.slots * self.pages_per_seq * full) // q8 + 1
             self.pool_pages = int(pp)
             self._caches = None
             self._arenas = [
                 PagedKVCache(self.pool_pages, self.page_size,
-                             cfg.num_key_value_heads, head_dim, cache_dtype)
+                             cfg.num_key_value_heads, head_dim, cache_dtype,
+                             quant=self.kv_quant)
                 for _ in range(cfg.num_hidden_layers)
             ]
             if self.tp > 1:
                 for a in self._arenas:
                     shard_kv_for_tp(a)
+            # observability (ISSUE 18): arena + scale HBM bytes as set (not
+            # accumulated) gauges, all layers included — /metrics renders
+            # them as paddle_kv_quant_*
+            page_b = kv_page_bytes(
+                self.page_size, cfg.num_key_value_heads, head_dim,
+                cache_dtype_bytes, self.kv_quant,
+            )
+            scale_b = (
+                2 * self.page_size * cfg.num_key_value_heads * 4
+                if self.kv_quant == "int8" else 0
+            )
+            _prof.record_kv_quant(
+                mode=self.kv_quant,
+                arena_bytes=cfg.num_hidden_layers * self.pool_pages
+                * (page_b - scale_b),
+                scale_bytes=cfg.num_hidden_layers * self.pool_pages * scale_b,
+            )
             self._pool = PagePool(self.pool_pages)
             use_prefix = bool(
                 _fcore.flag("FLAGS_serve_prefix_cache")
@@ -800,7 +853,9 @@ class ContinuousBatchingEngine:
         Tensors — data) across every layer's K and V, inside ONE compiled
         dispatch.  Used exactly once per admission that extends a partially
         filled shared page; decode never copies (frontier pages are always
-        exclusively owned)."""
+        exclusively owned).  Under an int8 arena the COW tail carries its
+        SCALE rows too — the copy dequantizes identically to its source,
+        and the writer's appends requantize only its own new rows."""
         from ..ops.dispatch import apply
 
         def f(c, s_, d_):
@@ -809,6 +864,13 @@ class ContinuousBatchingEngine:
         for a in self._arenas:
             a.k._data = apply(f, [a.k, src, dst], name="kv_page_copy")._data
             a.v._data = apply(f, [a.v, src, dst], name="kv_page_copy")._data
+            if a.k_scale is not None:
+                a.k_scale._data = apply(
+                    f, [a.k_scale, src, dst], name="kv_page_copy"
+                )._data
+                a.v_scale._data = apply(
+                    f, [a.v_scale, src, dst], name="kv_page_copy"
+                )._data
         return dst
 
     # -- public API ---------------------------------------------------------
@@ -1120,6 +1182,11 @@ class ContinuousBatchingEngine:
             # always present (0.0 before any traffic) so the scrape surface
             # and the autoscaler's pressure signal are shape-stable
             "deadline_miss_rate": round(self._miss_ewma, 4),
+            # KV storage precision (ISSUE 18): 'int8' replicas pack ~2x the
+            # pages into the same HBM — page_free_frac stays a FRACTION of
+            # this replica's own usable pages, so router scoring needs no
+            # mode awareness
+            "kv_quant": self.kv_quant,
             # mesh topology (ISSUE 14): degree + axis shape so a fleet
             # operator can see which replicas are TP-sharded from /healthz
             "tp": self.tp,
@@ -1952,6 +2019,17 @@ class ContinuousBatchingEngine:
                 _prof.record_paging_tick(
                     self._pool.used_count(), self._pool.usable_pages
                 )
+                if self.kv_quant == "int8":
+                    # per-layer work divided out: one KV row-pair quantized
+                    # per active slot, every mapped page dequantized in the
+                    # kernel's page walk
+                    _prof.record_kv_quant_event(
+                        "quantize", len(active_idx)
+                    )
+                    _prof.record_kv_quant_event(
+                        "dequantize",
+                        sum(len(self._slot_pages[s]) for s in active_idx),
+                    )
         return len(active_idx)
 
     def _decode_once_spec(self, gen):
@@ -2094,6 +2172,15 @@ class ContinuousBatchingEngine:
             _prof.record_speculation(
                 proposed, accepted, emitted_total, len(active_idx)
             )
+            if self.kv_quant == "int8":
+                # the verify window quantizes k+1 row-pairs per active slot
+                _prof.record_kv_quant_event(
+                    "quantize", len(active_idx) * K1
+                )
+                _prof.record_kv_quant_event(
+                    "dequantize",
+                    sum(len(self._slot_pages[s]) for s in active_idx),
+                )
         return len(active_idx)
 
     def _obs_epoch_open(self, active_idx):
@@ -2342,6 +2429,11 @@ class ContinuousBatchingEngine:
         written.  Caller holds _mu."""
         pool, ps = self._pool, self.page_size
         check_table_bounds(self._page_table, pool.num_pages)
+        # ISSUE 18: the scale arenas are audited alongside the K/V pages —
+        # congruence (same page count, [ps, kv_heads, 1] f32 rows) is the
+        # whole refcount story, because page p's scale rows share page p's
+        # refcount by construction
+        check_scale_arenas(self._arenas, pool.num_pages, ps)
         expected = np.zeros(pool.num_pages, np.int64)
         expected[0] = 1  # scratch pin
         for s in range(self.slots):

@@ -61,6 +61,91 @@ def spec_write_pages(pos, width, page_size, mapped_entries):
     return in_table, overrun
 
 
+# Quantized KV serving (ISSUE 18): 'int8' stores K/V pages as int8 with
+# per-token-row, per-kv-head float32 scales kept in a parallel scale arena
+# `[num_pages, page_size, kv_heads, 1]` (one per K and one per V per layer).
+# Scale rows are written by the SAME scatters that write the quantized page
+# rows and are addressed by the SAME page tables, so every piece of host
+# bookkeeping in this module — refcounts, COW, prefix chains — covers them
+# with zero extra state: holding a page holds its scale rows.
+KV_QUANT_MODES = ("none", "int8")
+
+
+class QuantConfigError(ValueError):
+    """Raised at engine CONSTRUCTION time for an invalid KV-quantization
+    configuration (unknown mode, quantized arena on a dense engine), so the
+    operator sees a typed, actionable error instead of a mid-traffic shape
+    or dtype mismatch inside a compiled step — the same contract as
+    distributed.sharding.ShardingError (ISSUE 14)."""
+
+
+def validate_kv_quant(mode, paged=True):
+    """Typed validation of a kv_quant mode string (QuantConfigError on
+    violation); returns the normalized mode.  Quantization requires the
+    paged engine — the dense slot pool has no scale-arena plumbing and is
+    kept as the full-precision bit-identity oracle."""
+    mode = "none" if mode is None else str(mode).strip().lower()
+    if mode not in KV_QUANT_MODES:
+        raise QuantConfigError(
+            f"kv_quant must be one of {'|'.join(KV_QUANT_MODES)}, got {mode!r}"
+        )
+    if mode != "none" and not paged:
+        raise QuantConfigError(
+            f"kv_quant={mode!r} requires the paged engine (paged=True): the "
+            "dense slot pool stays full-precision as the bit-identity oracle"
+        )
+    return mode
+
+
+def kv_page_bytes(page_size, kv_heads, head_dim, dtype_bytes, quant="none"):
+    """HBM bytes ONE layer's K+V storage spends per page.  Under 'int8'
+    every K/V element costs 1 byte plus a 4-byte float32 scale per
+    (token row, kv head) — the scale arena's trailing unit dim.  This is
+    the byte math behind FLAGS_serve_kv_pool_pages auto-sizing: the int8
+    pool gets `head_dim*dtype_bytes / (head_dim + 4)` times the pages the
+    same budget buys at full precision (~1.94x at bf16 head_dim=128)."""
+    if validate_kv_quant(quant) == "int8":
+        return 2 * int(page_size) * int(kv_heads) * (int(head_dim) + 4)
+    return 2 * int(page_size) * int(kv_heads) * int(head_dim) * int(dtype_bytes)
+
+
+def check_scale_arenas(arenas, num_pages, page_size):
+    """Debug-invariants audit of the scale arenas (ISSUE 18): every int8
+    layer arena must carry k_scale/v_scale buffers congruent with the K/V
+    arena — same leading page count (the tables index both), same
+    [page_size, kv_heads] row geometry, trailing unit dim, float32 — and a
+    'none' arena must carry none.  The pool's refcounts need no separate
+    scale accounting precisely BECAUSE of this congruence: page p's scale
+    rows live and die with page p.  Raises AssertionError on violation."""
+    for i, a in enumerate(arenas):
+        quant = getattr(a, "quant", "none")
+        ks, vs = getattr(a, "k_scale", None), getattr(a, "v_scale", None)
+        if quant != "int8":
+            if ks is not None or vs is not None:
+                raise AssertionError(
+                    f"scale invariant: layer {i} arena is quant={quant!r} "
+                    "but carries scale buffers"
+                )
+            continue
+        kvh = int(a.k.shape[2])
+        want = (int(num_pages), int(page_size), kvh, 1)
+        for name, t in (("k_scale", ks), ("v_scale", vs)):
+            if t is None:
+                raise AssertionError(
+                    f"scale invariant: layer {i} int8 arena missing {name}"
+                )
+            if tuple(int(d) for d in t.shape) != want:
+                raise AssertionError(
+                    f"scale invariant: layer {i} {name} shape "
+                    f"{tuple(t.shape)} != {want}"
+                )
+            if "float32" not in str(t.dtype):
+                raise AssertionError(
+                    f"scale invariant: layer {i} {name} dtype {t.dtype} "
+                    "is not float32"
+                )
+
+
 # Canonical tensor-parallel layout of every KV cache buffer (ISSUE 14):
 # paged arenas are [num_pages, page_size, kv_heads, head_dim] and dense slot
 # pools are [slots, max_len, kv_heads, head_dim] — both split the KV HEADS
@@ -84,6 +169,13 @@ def shard_kv_for_tp(cache):
     spec = P(None, None, "mp", None)
     _mesh.shard_tensor_(cache.k, spec)
     _mesh.shard_tensor_(cache.v, spec)
+    # int8 arenas (ISSUE 18): scale buffers share the [pages, page_size,
+    # kv_heads, 1] layout, so the same kv-heads sharding applies — each
+    # device holds exactly its local heads' scale rows
+    for name in ("k_scale", "v_scale"):
+        t = getattr(cache, name, None)
+        if t is not None:
+            _mesh.shard_tensor_(t, spec)
     return cache
 
 

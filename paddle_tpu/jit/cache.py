@@ -140,6 +140,7 @@ def _flags_fingerprint():
     amp_key = (amp.enabled, amp.level, amp.dtype) if amp is not None else None
     return (
         _core.flag("FLAGS_check_nan_inf"),
+        _core.flag("FLAGS_serve_kv_quant"),
         _core.get_default_dtype(),
         bool(jax.config.jax_enable_x64),
         amp_key,

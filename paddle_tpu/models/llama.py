@@ -207,19 +207,39 @@ class PagedKVCache:
     """One layer's paged K/V arena: `[num_pages, page_size, kv_heads,
     head_dim]` buffers addressed through per-slot page tables (traced data).
     Page 0 is scratch — inactive slots' all-zero table rows and every
-    masked scatter land there (see inference/paging.py)."""
+    masked scatter land there (see inference/paging.py).
 
-    def __init__(self, num_pages, page_size, kv_heads, head_dim, dtype="float32"):
+    quant="int8" (ISSUE 18) stores the K/V buffers as int8 and adds
+    `k_scale`/`v_scale` float32 buffers `[num_pages, page_size, kv_heads,
+    1]`: one symmetric scale per (token row, kv head), written by the same
+    scatters, addressed by the same tables, shared/copied by the same
+    refcount/COW machinery.  Per-ROW scales (not per-page) mean a decode
+    write never requantizes the rest of its page, and the trailing unit dim
+    keeps the scale tile 2-D for the fused kernel's BlockSpec."""
+
+    def __init__(self, num_pages, page_size, kv_heads, head_dim,
+                 dtype="float32", quant="none"):
         from ..framework import core as _fcore
 
         self.page_size = int(page_size)
-        zeros = np.zeros(
-            (num_pages, page_size, kv_heads, head_dim), _fcore.to_jax_dtype(dtype)
-        )
+        self.quant = str(quant)
+        if self.quant == "int8":
+            zeros = np.zeros((num_pages, page_size, kv_heads, head_dim), np.int8)
+            scales = np.zeros((num_pages, page_size, kv_heads, 1), np.float32)
+            self.k_scale = Tensor(scales)
+            self.v_scale = Tensor(scales.copy())
+        else:
+            zeros = np.zeros(
+                (num_pages, page_size, kv_heads, head_dim),
+                _fcore.to_jax_dtype(dtype),
+            )
+            self.k_scale = None
+            self.v_scale = None
         self.k = Tensor(zeros)
         self.v = Tensor(zeros.copy())
-        self.k.stop_gradient = True
-        self.v.stop_gradient = True
+        for t in (self.k, self.v, self.k_scale, self.v_scale):
+            if t is not None:
+                t.stop_gradient = True
 
 
 class PagedPrefillView:
@@ -338,6 +358,76 @@ def _rope_page_scatter(arena_k_t, arena_v_t, q, k, v, cos, sin, table_t,
     return apply(f, ins, multi=True, name="rope_page_scatter")
 
 
+def _quantize_kv_rows(x):
+    """Symmetric per-row int8 quantization of KV rows `[..., head_dim]`:
+    scale = max|x| / 127 over the head dim (float32), zero rows pinned to
+    scale 1 so their dequant is exactly zero.  Returns (int8 values,
+    float32 scales [..., 1]).  Traced inline inside the scatter ops, so
+    the rotated K (and raw V) quantize in-register — no full-precision
+    round trip through HBM on the way into the arena."""
+    import jax.numpy as jnp
+
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0.0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale), -127.0, 127.0).astype(jnp.int8)
+    return q, scale
+
+
+def _rope_page_scatter_quant(arena_k_t, arena_v_t, ks_t, vs_t, q, k, v, cos,
+                             sin, table_t, true_len_t, start_t=None):
+    """`_rope_page_scatter` for an int8 arena (ISSUE 18): identical RoPE +
+    page-address math, but the K/V rows quantize per (row, kv head) before
+    landing and the scales scatter into the parallel scale arenas through
+    the SAME page/row indices — one traced op still, so rope, quantize and
+    all four scatters fuse.  Redirected rows (padding, table overrun) drop
+    their garbage values AND scales on scratch page 0, where the position
+    fence masks them before any softmax.  Returns (q_rot, k_rot, new_ak,
+    new_av, new_ks, new_vs) — q_rot/k_rot stay full precision for the
+    prefill's own causal attention."""
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import apply
+
+    ps = arena_k_t.shape[1]
+    s = q.shape[1]
+
+    def f(ak, av, aks, avs, qa, ka, va, c, si, t, tl, *st):
+        if st:
+            idx = st[0][:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+            cc = c[idx][:, :, None, :].astype(qa.dtype)
+            si_ = si[idx][:, :, None, :].astype(qa.dtype)
+        else:
+            cc = c[0:s][None, :, None, :].astype(qa.dtype)
+            si_ = si[0:s][None, :, None, :].astype(qa.dtype)
+
+        def rot(x):
+            half = x.shape[-1] // 2
+            rh = jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+            return x * cc + rh * si_
+
+        q_rot, k_rot = rot(qa), rot(ka)
+        i = jnp.arange(s, dtype=jnp.int32)
+        gidx = (st[0][0] + i) if st else i
+        entry = gidx // ps
+        P = t.shape[0]
+        valid = (i < tl) & (entry < P)
+        pg = jnp.where(valid, t[jnp.minimum(entry, P - 1)], 0)
+        kq, ksc = _quantize_kv_rows(k_rot[0])
+        vq, vsc = _quantize_kv_rows(va[0])
+        new_ak = ak.at[pg, gidx % ps].set(kq)
+        new_av = av.at[pg, gidx % ps].set(vq)
+        new_ks = aks.at[pg, gidx % ps].set(ksc)
+        new_vs = avs.at[pg, gidx % ps].set(vsc)
+        return q_rot, k_rot, new_ak, new_av, new_ks, new_vs
+
+    ins = [arena_k_t, arena_v_t, ks_t, vs_t, q, k, v, cos, sin, table_t,
+           true_len_t]
+    if start_t is not None:
+        ins.append(start_t)
+    return apply(f, ins, multi=True, name="rope_page_scatter_q8")
+
+
 def _page_decode_write(arena_t, new_t, tables_t, pos_t):
     """Per-slot decode write: slot s's [s_q, kv_heads, d] token K/V rows land
     at page tables[s, (pos[s]+i)//page_size] row (pos[s]+i) % page_size for
@@ -377,6 +467,46 @@ def _page_decode_write(arena_t, new_t, tables_t, pos_t):
         return c.at[pg, idx % ps].set(n.astype(c.dtype))
 
     return apply(f, [arena_t, new_t, tables_t, pos_t], name="kv_page_decode_write")
+
+
+def _page_decode_write_quant(arena_t, scale_t, new_t, tables_t, pos_t):
+    """`_page_decode_write` for an int8 arena: the full-precision decode (or
+    verify-window) rows quantize per (row, kv head) in-register, then the
+    int8 values and their float32 scales scatter through the SAME page/row
+    addresses — one traced op, same branch structure (s_q == 1 plain decode
+    vs s_q > 1 verify with the scratch redirect), so the executables stay
+    byte-stable across slot churn exactly like the unquantized path.
+    Returns (new_arena, new_scales)."""
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import apply
+
+    ps = arena_t.shape[1]
+
+    def f(c, sc, n, t, p):
+        nq, ns = _quantize_kv_rows(n)
+        if n.shape[1] == 1:
+            entry = p // ps  # [slots]; pos < pages*ps by the admission math
+            pg = jnp.take_along_axis(t, entry[:, None], axis=1)[:, 0]
+            return (
+                c.at[pg, p % ps].set(nq[:, 0]),
+                sc.at[pg, p % ps].set(ns[:, 0]),
+            )
+        sq = n.shape[1]
+        idx = p[:, None] + jnp.arange(sq, dtype=p.dtype)[None, :]  # [slots, sq]
+        entry = idx // ps
+        P = t.shape[1]
+        pg = jnp.where(
+            entry < P,
+            jnp.take_along_axis(t, jnp.minimum(entry, P - 1), axis=1),
+            0,
+        )
+        return c.at[pg, idx % ps].set(nq), sc.at[pg, idx % ps].set(ns)
+
+    return apply(
+        f, [arena_t, scale_t, new_t, tables_t, pos_t], multi=True,
+        name="kv_page_decode_write_q8",
+    )
 
 
 def _lora_add(lora, target, y, x):
@@ -443,16 +573,32 @@ class LlamaAttention(nn.Layer):
             [b, s, self.num_kv_heads, self.head_dim]
         )
         if isinstance(cache, PagedPrefillView):
+            quant = getattr(cache.arena, "quant", "none") == "int8"
             if cache.start is None:
                 # fresh paged prefill: identical math to the dense SlotView
                 # path (rope offset 0, causal SDPA over the prompt) — only
                 # WHERE the K/V rows land differs, so paged and dense
                 # engines produce bit-identical tokens.  RoPE + both page
-                # scatters run as ONE fused op (no activation round-trip)
-                q, k, new_ak, new_av = _rope_page_scatter(
-                    cache.arena.k, cache.arena.v, q, k, v,
-                    self.rope_cos, self.rope_sin, cache.table, cache.true_len,
-                )
+                # scatters run as ONE fused op (no activation round-trip).
+                # Under an int8 arena the scatter quantizes on write, but
+                # the prompt's own attention below still runs on the full-
+                # precision k/v in register — first tokens stay exact
+                if quant:
+                    q, k, new_ak, new_av, new_ks, new_vs = \
+                        _rope_page_scatter_quant(
+                            cache.arena.k, cache.arena.v,
+                            cache.arena.k_scale, cache.arena.v_scale,
+                            q, k, v, self.rope_cos, self.rope_sin,
+                            cache.table, cache.true_len,
+                        )
+                    cache.arena.k_scale._data = new_ks._data
+                    cache.arena.v_scale._data = new_vs._data
+                else:
+                    q, k, new_ak, new_av = _rope_page_scatter(
+                        cache.arena.k, cache.arena.v, q, k, v,
+                        self.rope_cos, self.rope_sin, cache.table,
+                        cache.true_len,
+                    )
                 cache.arena.k._data = new_ak._data
                 cache.arena.v._data = new_av._data
                 out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
@@ -461,17 +607,30 @@ class LlamaAttention(nn.Layer):
                 # offset `start` scatter into their pages, then attend the
                 # whole sequence — shared prefix included — through the
                 # table gather; row i sees j <= start + i
-                q, k, new_ak, new_av = _rope_page_scatter(
-                    cache.arena.k, cache.arena.v, q, k, v,
-                    self.rope_cos, self.rope_sin, cache.table, cache.true_len,
-                    cache.start,
-                )
+                if quant:
+                    q, k, new_ak, new_av, new_ks, new_vs = \
+                        _rope_page_scatter_quant(
+                            cache.arena.k, cache.arena.v,
+                            cache.arena.k_scale, cache.arena.v_scale,
+                            q, k, v, self.rope_cos, self.rope_sin,
+                            cache.table, cache.true_len, cache.start,
+                        )
+                    cache.arena.k_scale._data = new_ks._data
+                    cache.arena.v_scale._data = new_vs._data
+                else:
+                    q, k, new_ak, new_av = _rope_page_scatter(
+                        cache.arena.k, cache.arena.v, q, k, v,
+                        self.rope_cos, self.rope_sin, cache.table,
+                        cache.true_len, cache.start,
+                    )
                 cache.arena.k._data = new_ak._data
                 cache.arena.v._data = new_av._data
                 out = F.paged_flash_decode(
                     q, cache.arena.k, cache.arena.v,
                     cache.table.reshape([1, -1]), cache.start, cache.max_len,
                     kernel=getattr(cache, "kernel", "auto"),
+                    k_scale=cache.arena.k_scale if quant else None,
+                    v_scale=cache.arena.v_scale if quant else None,
                 )
             out = out.reshape([b, s, self.num_heads * self.head_dim])
             return _lora_add(lora, "o_proj", self.o_proj(out), out), cache
@@ -480,16 +639,31 @@ class LlamaAttention(nn.Layer):
             # as the dense StaticKVCache path; the page-table indirection
             # happens inside the compiled step (tables are data) — fused
             # in-kernel on the Pallas path, gather-then-dense otherwise
+            quant = getattr(cache.arena, "quant", "none") == "int8"
             q, k = apply_rotary_pos_emb(q, k, self.rope_cos, self.rope_sin, pos)
-            cache.arena.k._data = _page_decode_write(
-                cache.arena.k, k, cache.tables, pos
-            )._data
-            cache.arena.v._data = _page_decode_write(
-                cache.arena.v, v, cache.tables, pos
-            )._data
+            if quant:
+                new_ak, new_ks = _page_decode_write_quant(
+                    cache.arena.k, cache.arena.k_scale, k, cache.tables, pos
+                )
+                new_av, new_vs = _page_decode_write_quant(
+                    cache.arena.v, cache.arena.v_scale, v, cache.tables, pos
+                )
+                cache.arena.k._data = new_ak._data
+                cache.arena.v._data = new_av._data
+                cache.arena.k_scale._data = new_ks._data
+                cache.arena.v_scale._data = new_vs._data
+            else:
+                cache.arena.k._data = _page_decode_write(
+                    cache.arena.k, k, cache.tables, pos
+                )._data
+                cache.arena.v._data = _page_decode_write(
+                    cache.arena.v, v, cache.tables, pos
+                )._data
             out = F.paged_flash_decode(
                 q, cache.arena.k, cache.arena.v, cache.tables, pos,
                 cache.max_len, kernel=getattr(cache, "kernel", "auto"),
+                k_scale=cache.arena.k_scale if quant else None,
+                v_scale=cache.arena.v_scale if quant else None,
             )
             out = out.reshape([b, s, self.num_heads * self.head_dim])
             return _lora_add(lora, "o_proj", self.o_proj(out), out), cache

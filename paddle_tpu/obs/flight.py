@@ -187,6 +187,12 @@ def dump(reason, path=None):
             asc = _prof.autoscale_summary()
             if asc:
                 header["autoscale"] = asc
+            # KV-arena precision at death: "was this replica serving int8
+            # pages, how much HBM did values vs scales hold" — without it a
+            # cross-replica capacity comparison silently mixes precisions
+            kvq = _prof.kv_quant_summary()
+            if kvq:
+                header["kv_quant"] = kvq
             # kernel dispatch at death: "was the hot path on the Pallas
             # kernels or silently on the XLA fallback" — the perf
             # post-mortem's first question

@@ -49,6 +49,9 @@ Metric-name reference (the stable surface the scrape test pins):
     paddle_autoscaler_replicas / _replicas_peak
     paddle_mesh_devices / paddle_mesh_tp_degree
     paddle_mesh_allreduce_per_step
+    paddle_kv_quant_mode{mode=...} 1
+    paddle_kv_quant_arena_bytes / paddle_kv_quant_scale_bytes
+    paddle_kv_quant_page_ops_total{op="quantize"|"dequantize"}
     paddle_flash_fallbacks_total{reason=...}  (zero-filled label set)
     paddle_flash_pallas_calls_total{kernel=...}  (zero-filled label set)
     paddle_sanitizer_<counter>_total  (traces, eager_misses, host_syncs,
@@ -234,6 +237,20 @@ def render(labels=None):
     exp.add("paddle_mesh_allreduce_per_step", g["allreduce_per_step"],
             "static GSPMD allreduces per compiled step (row-parallel "
             "outputs + sampling reduction; 0 at tp=1)", "gauge")
+
+    g = snap.get("kv_quant", {})
+    exp.add("paddle_kv_quant_mode", 1,
+            "paged-KV arena storage precision (1 = current mode)", "gauge",
+            {"mode": g.get("mode", "none")})
+    exp.add("paddle_kv_quant_arena_bytes", g.get("arena_bytes", 0),
+            "K/V value-arena HBM bytes across all layers", "gauge")
+    exp.add("paddle_kv_quant_scale_bytes", g.get("scale_bytes", 0),
+            "per-row dequant scale-arena HBM bytes (0 unless quantized)",
+            "gauge")
+    for op in ("quantize", "dequantize"):
+        exp.add("paddle_kv_quant_page_ops_total", g.get(op, 0),
+                "KV quant-path work: rows quantized on write / mapped pages "
+                "dequantized in-kernel", "counter", {"op": op})
 
     g = snap["router"]
     for key, name in (

@@ -179,7 +179,8 @@ def _dispatch_salt():
 
         _fa_mod = sys.modules.get("paddle_tpu.ops.flash_attention")
     fa_key = getattr(_fa_mod, "_FORCE_INTERPRET", None)
-    return (mesh, amp_key, _core.flag("FLAGS_check_nan_inf"), fa_key)
+    return (mesh, amp_key, _core.flag("FLAGS_check_nan_inf"),
+            _core.flag("FLAGS_serve_kv_quant"), fa_key)
 
 
 def _cache_get(key, builder):

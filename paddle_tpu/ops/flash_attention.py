@@ -958,6 +958,150 @@ def _fused_paged_decode_bwd(max_len, scale, interpret, res, g):
 _fused_paged_decode.defvjp(_fused_paged_decode_fwd, _fused_paged_decode_bwd)
 
 
+def _fused_paged_decode_quant_forward(q, arena_k, arena_v, k_scale, v_scale,
+                                      tables, pos, max_len, scale,
+                                      interpret=False):
+    """`_fused_paged_decode_forward` over an int8 arena (ISSUE 18): the K/V
+    page tiles arrive as int8 and their per-row scales ([page_size, 1]
+    float32 tiles from the parallel scale arenas, addressed by the SAME
+    `t[s*P+j]` table lookup in their BlockSpec index maps) ride into VMEM
+    with them; dequantization — `tile.astype(f32) * scale_row` — happens
+    per page tile inside the online-softmax loop, so the arena's HBM
+    footprint is what streams: 1 byte per element plus 4 bytes per (row,
+    head) instead of 2.  q is cast to f32 in-kernel so the dot runs at the
+    dequantized precision the gather oracle uses — fused-vs-gather parity
+    holds under quantization too.  Masks and the softmax recurrence are
+    byte-identical to the unquantized kernel: scratch-page garbage scales
+    are finite by construction and fenced by `jid <= pos + w` before they
+    could reach a softmax."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, h, d = q.shape
+    ps = arena_k.shape[1]
+    hk = arena_k.shape[2]
+    rep = h // hk
+    P = tables.shape[1]
+    R = rep * sq
+    qr = -(-R // 8) * 8  # f32 sublane tile; pad rows are sliced off
+    qt = jnp.transpose(q, (0, 2, 1, 3)).reshape(b, hk, rep, sq, d)
+    qg = qt.reshape(b, hk, R, d)
+    if qr != R:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, qr - R), (0, 0)))
+    pos_v = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (b,))
+    tab = jnp.asarray(tables, jnp.int32).reshape(-1)
+
+    def kernel(t_ref, p_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+               m_scr, l_scr, acc_scr):
+        j = pl.program_id(2)
+        n_p = pl.num_programs(2)
+        p0 = p_ref[pl.program_id(0)]
+
+        @pl.when(j == 0)
+        def _init():
+            m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+            l_scr[...] = jnp.zeros_like(l_scr)
+            acc_scr[...] = jnp.zeros_like(acc_scr)
+
+        needed = j * ps <= p0 + sq - 1
+
+        @pl.when(needed)
+        def _compute():
+            qb = q_ref[...].astype(jnp.float32)  # [qr, d]
+            # in-VMEM dequant: int8 page tile * its [ps, 1] scale column
+            kb = k_ref[...].astype(jnp.float32) * ks_ref[...]
+            vb = v_ref[...].astype(jnp.float32) * vs_ref[...]
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [qr, ps]
+            w = jax.lax.broadcasted_iota(jnp.int32, (qr, ps), 0) % sq
+            jid = j * ps + jax.lax.broadcasted_iota(jnp.int32, (qr, ps), 1)
+            s = jnp.where((jid <= p0 + w) & (jid < max_len), s, _NEG_INF)
+            m = m_scr[..., 0]
+            l = l_scr[..., 0]
+            m_new = jnp.maximum(m, s.max(-1))
+            p = jnp.exp(s - m_new[..., None])
+            alpha = jnp.exp(m - m_new)
+            m_scr[...] = m_new[..., None]
+            l_scr[...] = (alpha * l + p.sum(-1))[..., None]
+            acc_scr[...] = acc_scr[...] * alpha[..., None] + jax.lax.dot_general(
+                p, vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        @pl.when(j == n_p - 1)
+        def _finish():
+            l_safe = jnp.maximum(l_scr[..., 0], 1e-30)
+            o_ref[...] = (acc_scr[...] / l_safe[..., None]).astype(o_ref.dtype)
+
+    page_tile = pl.BlockSpec(
+        (None, ps, None, d), lambda s, g, j, t, p: (t[s * P + j], 0, g, 0)
+    )
+    scale_tile = pl.BlockSpec(
+        (None, ps, None, 1), lambda s, g, j, t, p: (t[s * P + j], 0, g, 0)
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hk, P),
+        in_specs=[
+            pl.BlockSpec((None, None, qr, d), lambda s, g, j, t, p: (s, g, 0, 0)),
+            page_tile,
+            page_tile,
+            scale_tile,
+            scale_tile,
+        ],
+        out_specs=pl.BlockSpec(
+            (None, None, qr, d), lambda s, g, j, t, p: (s, g, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((qr, 1), jnp.float32),
+            pltpu.VMEM((qr, 1), jnp.float32),
+            pltpu.VMEM((qr, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hk, qr, d), q.dtype),
+        interpret=interpret,
+    )(tab, pos_v, qg, arena_k, arena_v, k_scale, v_scale)
+    out = out[:, :, :R].reshape(b, hk, rep, sq, d).reshape(b, h, sq, d)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9))
+def _fused_paged_decode_quant(q, arena_k, arena_v, k_scale, v_scale, tables,
+                              pos, max_len, scale, interpret):
+    """Differentiation-opaque wrapper over the quantized fused kernel —
+    same contract as `_fused_paged_decode` (decode is inference-only)."""
+    return _fused_paged_decode_quant_forward(
+        q, arena_k, arena_v, k_scale, v_scale, tables, pos, max_len, scale,
+        interpret=interpret,
+    )
+
+
+def _fused_paged_decode_quant_fwd(q, arena_k, arena_v, k_scale, v_scale,
+                                  tables, pos, max_len, scale, interpret):
+    out = _fused_paged_decode_quant_forward(
+        q, arena_k, arena_v, k_scale, v_scale, tables, pos, max_len, scale,
+        interpret=interpret,
+    )
+    return out, None
+
+
+def _fused_paged_decode_quant_bwd(max_len, scale, interpret, res, g):
+    raise NotImplementedError(
+        "quantized fused paged decode attention is inference-only (no "
+        "backward); differentiate through kernel='gather' instead"
+    )
+
+
+_fused_paged_decode_quant.defvjp(
+    _fused_paged_decode_quant_fwd, _fused_paged_decode_quant_bwd
+)
+
+
 def _fused_paged_viable(q, page_size):
     """Static eligibility for the fused paged kernel.  The arena page IS
     the kernel's K/V block, so page_size must be a sublane multiple; head
@@ -1001,8 +1145,33 @@ def _fused_paged_decode_tp(q, arena_k, arena_v, tables, pos, max_len, scale,
     return fn(q, arena_k, arena_v, tables, pos)
 
 
+def _fused_paged_decode_quant_tp(q, arena_k, arena_v, k_scale, v_scale,
+                                 tables, pos, max_len, scale, interpret, mp):
+    """Tensor-parallel dispatch of the QUANTIZED fused kernel: identical
+    shard_map contract to `_fused_paged_decode_tp`, with the scale arenas
+    riding the same kv-heads 'mp' sharding (their axis 2 is kv_heads too) —
+    each device dequantizes only its local heads' pages in VMEM."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..distributed import mesh as _mesh
+
+    heads = P(None, None, "mp", None)
+    fn = shard_map(
+        lambda qq, ak, av, ks, vs, t, p: _fused_paged_decode_quant(
+            qq, ak, av, ks, vs, t, p, max_len, scale, interpret
+        ),
+        mesh=_mesh.get_mesh(),
+        in_specs=(heads, heads, heads, heads, heads, P(None, None), P(None)),
+        out_specs=heads,
+        check_rep=False,
+    )
+    return fn(q, arena_k, arena_v, k_scale, v_scale, tables, pos)
+
+
 def paged_decode_attention_array(q, arena_k, arena_v, tables, pos, max_len,
-                                 scale=None, kernel="auto"):
+                                 scale=None, kernel="auto", k_scale=None,
+                                 v_scale=None):
     """Paged-decode attention dispatcher.
 
     kernel="auto": the fused Pallas kernel when on TPU (or under interpret)
@@ -1018,11 +1187,22 @@ def paged_decode_attention_array(q, arena_k, arena_v, tables, pos, max_len,
     Under a tensor-parallel 'mp' mesh the fused kernel goes through
     `shard_map` (kv_heads axis sharded; see `_fused_paged_decode_tp`) and
     the gather oracle relies on GSPMD propagating the arena's heads
-    sharding through the gather + dense einsums."""
+    sharding through the gather + dense einsums.
+
+    k_scale/v_scale non-None selects the QUANTIZED paths (ISSUE 18): the
+    arena holds int8 rows and the scale arenas hold their per-(row, kv
+    head) float32 scales.  The fused kernel dequantizes per page tile in
+    VMEM ('paged_decode_fused_q8'); the gather oracle gathers values and
+    scales through the same tables and applies the identical
+    `int8 * scale` dequant before the dense math, staying the parity
+    baseline under quantization too."""
     if kernel not in ("auto", "fused", "gather"):
         raise ValueError(
             f"paged decode kernel must be auto|fused|gather, got {kernel!r}"
         )
+    quant = k_scale is not None
+    if quant != (v_scale is not None):
+        raise ValueError("k_scale and v_scale must be given together")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     interpret = _FORCE_INTERPRET
@@ -1038,7 +1218,18 @@ def paged_decode_attention_array(q, arena_k, arena_v, tables, pos, max_len,
             ok, reason = False, "paged heads not divisible by mp"
         on_path = _on_tpu() or interpret
         if ok and on_path:
-            _log_pallas_call("paged_decode_fused")
+            _log_pallas_call("paged_decode_fused_q8" if quant else
+                             "paged_decode_fused")
+            if quant:
+                if mp > 1:
+                    return _fused_paged_decode_quant_tp(
+                        q, arena_k, arena_v, k_scale, v_scale, tables, pos,
+                        max_len, scale, interpret, mp,
+                    )
+                return _fused_paged_decode_quant(
+                    q, arena_k, arena_v, k_scale, v_scale, tables, pos,
+                    max_len, scale, interpret,
+                )
             if mp > 1:
                 return _fused_paged_decode_tp(
                     q, arena_k, arena_v, tables, pos, max_len, scale,
@@ -1056,18 +1247,49 @@ def paged_decode_attention_array(q, arena_k, arena_v, tables, pos, max_len,
             _log_pallas_fallback(reason, shape=q.shape)
     k = paged_gather_kv(arena_k, tables, max_len)
     v = paged_gather_kv(arena_v, tables, max_len)
+    if quant:
+        # the oracle's dequant is the same math the kernel runs in VMEM:
+        # int8 rows * their gathered scale rows, q upcast to f32 so both
+        # paths reduce at the same precision
+        k = k.astype(jnp.float32) * paged_gather_kv(k_scale, tables, max_len)
+        v = v.astype(jnp.float32) * paged_gather_kv(v_scale, tables, max_len)
+        out = decode_attention_array(q.astype(jnp.float32), k, v, pos, scale)
+        return out.astype(q.dtype)
     return decode_attention_array(q, k, v, pos, scale)
 
 
 def paged_flash_decode(query, arena_k, arena_v, tables, pos, max_len, scale=None,
-                       kernel="auto"):
-    """Tensor-level paged cached-decode attention."""
+                       kernel="auto", k_scale=None, v_scale=None):
+    """Tensor-level paged cached-decode attention.  `k_scale`/`v_scale`
+    (the int8 arena's parallel scale buffers) select the quantized
+    dispatch; the kv-quant mode string is deliberately a closure constant
+    of the traced fn — ops.dispatch._code_key and the AOT snapshot
+    fingerprint freeze closure values, so an executable cached under one
+    quant mode can never serve the other even if avals were ever to
+    coincide."""
     query, arena_k, arena_v = coerce(query), coerce(arena_k), coerce(arena_v)
     tables, pos = coerce(tables), coerce(pos)
     max_len = int(max_len)
     kernel = str(kernel)
+    kv_quant = "int8" if k_scale is not None else "none"
+
+    if kv_quant == "int8":
+        k_scale, v_scale = coerce(k_scale), coerce(v_scale)
+
+        def fq(q, ak, av, ks, vs, t, p):
+            assert kv_quant == "int8"  # closure cell -> eager-cache key
+            return paged_decode_attention_array(
+                q, ak, av, t, p, max_len, scale, kernel=kernel,
+                k_scale=ks, v_scale=vs,
+            )
+
+        return apply(
+            fq, [query, arena_k, arena_v, k_scale, v_scale, tables, pos],
+            name="paged_flash_decode_q8",
+        )
 
     def f(q, ak, av, t, p):
+        assert kv_quant == "none"  # closure cell -> eager-cache key
         return paged_decode_attention_array(
             q, ak, av, t, p, max_len, scale, kernel=kernel
         )
@@ -1203,7 +1425,10 @@ def _flash_backward(q, k, v, mask, out, lse, g, causal, scale, block_k=512):
 # regression shows up as a counter MOVING, not a series appearing.  The two
 # retired reasons ("seq not a 128-multiple", "attn_mask given") stay listed:
 # their permanent zeros are the proof the gaps are closed.
-_PALLAS_KERNELS = ("flash_fwd", "flash_bwd", "decode", "paged_decode_fused")
+_PALLAS_KERNELS = (
+    "flash_fwd", "flash_bwd", "decode", "paged_decode_fused",
+    "paged_decode_fused_q8",
+)
 _FALLBACK_REASONS = (
     "attn_mask not key-padding",
     "q/k shapes differ",

@@ -298,6 +298,7 @@ def reset():
         _reset_router_locked()
         _reset_autoscale_locked()
         _reset_mesh_locked()
+        _reset_kv_quant_locked()
         _flash_fallbacks.clear()
         _flash_pallas.clear()
 
@@ -322,6 +323,7 @@ def metrics_snapshot():
             "router": router,
             "autoscale": dict(_autoscale_gauges),
             "mesh": dict(_mesh_gauges),
+            "kv_quant": dict(_kv_quant_gauges),
             "flash_fallbacks": dict(_flash_fallbacks),
             "flash_pallas": dict(_flash_pallas),
         }
@@ -434,6 +436,67 @@ def mesh_summary():
     with _counters_lock:
         g = dict(_mesh_gauges)
     if not g["devices"]:
+        return {}
+    return g
+
+
+# ---------------------------------------------------------------------------
+# KV-quantization gauges (ISSUE 18): the paged engine records its arena
+# precision at construction — mode, value-arena HBM bytes, scale-arena HBM
+# bytes (set, not accumulated, like the mesh descriptors) — and counts
+# quantize/dequantize page operations as decode traffic flows, so "which
+# precision is this replica serving at and is the quant path actually hot"
+# is answerable from /metrics and the flight-recorder header.
+# ---------------------------------------------------------------------------
+
+_kv_quant_gauges = {
+    "mode": "none",      # arena storage precision ('none' | 'int8')
+    "arena_bytes": 0,    # K/V value-arena HBM bytes across all layers
+    "scale_bytes": 0,    # scale-arena HBM bytes (0 unless quantized)
+    "quantize": 0,       # KV row-pairs quantized on write (per slot-step)
+    "dequantize": 0,     # mapped pages dequantized per decode dispatch
+}
+
+
+def record_kv_quant(mode, arena_bytes, scale_bytes):
+    """Record the paged arena's storage precision (engine construction)."""
+    with _counters_lock:
+        g = _kv_quant_gauges
+        g["mode"] = str(mode)
+        g["arena_bytes"] = int(arena_bytes)
+        g["scale_bytes"] = int(scale_bytes)
+
+
+def record_kv_quant_event(kind, n=1):
+    """Count quant-path work: 'quantize' (KV row-pairs written through the
+    quantizing scatters) or 'dequantize' (mapped pages the decode kernel
+    dequantized in VMEM)."""
+    with _counters_lock:
+        g = _kv_quant_gauges
+        g[kind] = g.get(kind, 0) + int(n)
+
+
+def _reset_kv_quant_locked():
+    _kv_quant_gauges["mode"] = "none"
+    _kv_quant_gauges["arena_bytes"] = 0
+    _kv_quant_gauges["scale_bytes"] = 0
+    _kv_quant_gauges["quantize"] = 0
+    _kv_quant_gauges["dequantize"] = 0
+
+
+def reset_kv_quant():
+    with _counters_lock:
+        _reset_kv_quant_locked()
+
+
+def kv_quant_summary():
+    """Current KV-quant descriptors ({} while no QUANTIZED arena has been
+    recorded — full-precision processes omit the flight-header section, the
+    same contract as mesh/lora; /metrics still renders the family via
+    metrics_snapshot())."""
+    with _counters_lock:
+        g = dict(_kv_quant_gauges)
+    if g["mode"] == "none":
         return {}
     return g
 

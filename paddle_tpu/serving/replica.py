@@ -457,6 +457,13 @@ def main(argv=None):
              "token-identical to --tp 1, so mixed-degree fleets still "
              "satisfy the failover contract)",
     )
+    p.add_argument(
+        "--kv-quant", default="none", choices=("none", "int8"),
+        help="KV-cache storage precision (forces the paged engine): 'int8' "
+             "stores K/V pages as int8 with per-row float32 scales, roughly "
+             "doubling the page pool the same HBM budget buys; the fused "
+             "decode kernel dequantizes per page tile in VMEM",
+    )
     args = p.parse_args(argv)
 
     import numpy as np
@@ -484,11 +491,12 @@ def main(argv=None):
         for i, spec in enumerate(args.lora.split(",")):
             name, _, rank = spec.partition(":")
             make_random(reg, name, rank=int(rank) if rank else 4, seed=i + 1)
-        extra = {
-            "paged": True,
-            "page_size": 8,
-            "lora": AdapterArena(reg),
-        }
+        extra.update(paged=True, page_size=8, lora=AdapterArena(reg))
+    if args.kv_quant != "none":
+        # quantized arenas only exist on the paged engine; the flag opts
+        # the replica into paging rather than erroring on the dense cache
+        extra.update(paged=True, kv_quant=args.kv_quant)
+        extra.setdefault("page_size", 8)
     eng = ContinuousBatchingEngine(
         model,
         slots=args.slots,

@@ -42,6 +42,7 @@ _SANITIZED_MODULES = {
     "test_spec_decode",
     "test_lora_serving",
     "test_fused_paged_attention",
+    "test_kv_quant",
     "test_tp_serving",
     "test_autoscale_soak",
 }

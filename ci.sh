@@ -25,15 +25,23 @@
 #                  against subprocess replicas while the closed-loop
 #                  autoscaler scales 1 -> N -> 1 through scheduled kill -9 /
 #                  hang / flap / failed-spawn chaos; exactly-once resolution,
-#                  miss rate under the bar, flight dump replays the decisions
+#                  miss rate under the bar, flight dump replays the decisions.
+#                  Runs over a TP-sharded fleet (SOAK_TP, default 2): every
+#                  worker boots --tp N on the 8-device CPU-sim mesh
+#   chaos-disagg — the DISAGGREGATED-serving drills (ISSUE 19): the full
+#                  prefill/decode handoff suite plus the slow kill -9 drill —
+#                  2 prefill + 2 TP-sharded decode subprocess workers under
+#                  concurrent load, SIGKILL one of each mid-handoff /
+#                  mid-stream; every request resolves exactly once with
+#                  tokens bit-identical to the single-engine reference
 set -euo pipefail
 cd "$(dirname "$0")"
 
 MODE="${1:-}"
 case "${MODE:-}" in
-  ""|fast|chaos|chaos-serve|chaos-router|chaos-router-ha|soak) ;;
+  ""|fast|chaos|chaos-serve|chaos-router|chaos-router-ha|soak|chaos-disagg) ;;
   *)
-    echo "usage: ./ci.sh [fast|chaos|chaos-serve|chaos-router|chaos-router-ha|soak]" >&2
+    echo "usage: ./ci.sh [fast|chaos|chaos-serve|chaos-router|chaos-router-ha|soak|chaos-disagg]" >&2
     exit 2
     ;;
 esac
@@ -137,11 +145,16 @@ if [ "$MODE" = "soak" ]; then
   # timeout(1) wrapper is the layer above every in-test deadline — a
   # wedged replica boot, drain, or control loop must fail CI, not hang
   # it.  PADDLE_OBS_DIR collects the post-mortem flight dump the test
-  # writes (scaling decisions + chaos, asserted parseable below)
+  # writes (scaling decisions + chaos, asserted parseable below).
+  # SOAK_TP (default 2, ISSUE 19 satellite) shards every worker --tp N
+  # over the 8-device CPU-sim mesh, so the control loop's choose_tp
+  # device-claim accounting runs against genuinely sharded replicas
   OBS_DIR="$(mktemp -d)/flightrec"
   timeout -k 30 1080 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
       PADDLE_OBS_DIR="$OBS_DIR" \
       SOAK_DURATION_S="${SOAK_DURATION_S:-600}" \
+      SOAK_TP="${SOAK_TP:-2}" \
       python -m pytest \
       "tests/test_autoscale_soak.py::test_soak_step_function_chaos" \
       -q -p no:cacheprovider
@@ -149,6 +162,23 @@ if [ "$MODE" = "soak" ]; then
       || { echo "FAIL: no flight-recorder dump after the soak" >&2; exit 1; }
   echo "flight-recorder dumps: $(ls "$OBS_DIR" | wc -l) in $OBS_DIR"
   echo "SOAK OK"
+  exit 0
+fi
+
+if [ "$MODE" = "chaos-disagg" ]; then
+  echo "== disaggregated-serving chaos suite (ISSUE 19, hard 20min cap) =="
+  # the whole handoff file including the slow drill: wire-format typed
+  # rejection, export -> reserve -> import bit-identity with frozen
+  # compiles on both sides, the in-process crash/drop/decode-death
+  # drills, and the subprocess kill -9 drill (2 prefill + 2 decode --tp 2
+  # workers; SIGKILL one of each mid-flight, exactly-once resolution,
+  # tokens bit-identical to the single-engine reference).  The module is
+  # sanitized: an unexpected recompile on either handoff side fails CI
+  timeout -k 30 1200 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python -m pytest tests/test_disagg_serving.py \
+      -q -p no:cacheprovider
+  echo "CHAOS-DISAGG OK"
   exit 0
 fi
 
@@ -350,6 +380,22 @@ AUTOSCALE_TESTS=(tests/test_autoscale_soak.py::test_autoscaler_live_scale_cycle_
 [ "$MODE" != "fast" ] && AUTOSCALE_TESTS=(tests/test_autoscale_soak.py)
 timeout -k 30 600 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python -m pytest "${AUTOSCALE_TESTS[@]}" -q -m "not slow" -p no:cacheprovider
+
+echo "== disaggregated-serving smoke (ISSUE 19 acceptance subset) =="
+# both tiers run the disagg core under the runtime sanitizer: the router's
+# (prefill, decode) pipeline streams tokens bit-identical to the colocated
+# reference with frozen compiles on BOTH handoff sides, and the
+# disagg.prefill.crash drill resolves as a zero-token retriable failover
+# (exactly-once: the decode side imports exactly one handoff); fast mode
+# runs that pair, full mode the whole non-slow file (wire-format typed
+# rejection, reservations/TTL, /reserve + /prefill endpoints, pick_pair
+# scoring + NoDecodeCapacity, handoff-drop + decode-death drills, role
+# autoscaler bands; the subprocess kill -9 drill lives in chaos-disagg)
+DISAGG_TESTS=(tests/test_disagg_serving.py::test_router_disagg_pipeline_bit_identical
+              tests/test_disagg_serving.py::test_prefill_crash_drill_zero_token_failover)
+[ "$MODE" != "fast" ] && DISAGG_TESTS=(tests/test_disagg_serving.py)
+timeout -k 30 600 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python -m pytest "${DISAGG_TESTS[@]}" -q -m "not slow" -p no:cacheprovider
 
 echo "== observability smoke (ISSUE 10 acceptance subset) =="
 # both tiers scrape a live replica's /metrics (stable name set, replica

@@ -234,3 +234,5 @@ register("router.replica.flap", "fails the router's /healthz probe of a replica 
 register("router.replica.kill", "SIGKILLs a router-managed replica process at probe time (kill -9 chaos drill)")
 register("autoscale.spawn", "fires when the autoscaler spawns a replica (failed-scale-up drill: the loop must absorb the failure and retry after the cooldown)")
 register("router.crash", "kills the serving ROUTER at probe time (front-door kill -9 drill: heartbeat goes stale, the warm standby replays the journal, re-probes the fleet, and resumes serving exactly-once)")
+register("disagg.prefill.crash", "kills a prefill worker's /prefill hop mid-handoff (connection dropped without a byte of response: the router must treat it as a zero-token retriable failover)")
+register("disagg.handoff.drop", "drops the serialized handoff payload between the prefill and decode hops (router-side; the request retries the whole pipeline exactly-once, the decode-side reservation expires by TTL)")

@@ -539,6 +539,26 @@ define_flag(
     "otherwise).  1 disables (single-device engine, no mesh installed)",
 )
 define_flag(
+    "FLAGS_serve_role", "colocated",
+    "disaggregated serving: role this replica plays in the fleet — "
+    "'colocated' (classic single-box engine: prefill and decode on the "
+    "same worker), 'prefill' (runs chunked prefill into committed pages "
+    "and exports the quantized page rows + prefix-chain metadata as a "
+    "handoff payload; never decodes past the first token), or 'decode' "
+    "(imports handoff payloads into its own page arena via a compiled "
+    "page scatter and streams the remaining tokens).  prefill/decode "
+    "roles require the paged engine (the handoff rides the page arenas)",
+)
+define_flag(
+    "FLAGS_serve_reserve_ttl_s", 30.0,
+    "disaggregated serving: seconds a decode-side page reservation "
+    "(POST /reserve) stays valid before it is reclaimed.  The router "
+    "reserves decode pages before prefill starts so the handoff can "
+    "never strand a finished prefill with nowhere to land; a crashed "
+    "router or dropped handoff simply lets the TTL expire, returning "
+    "the reserved headroom to the admission path",
+)
+define_flag(
     "FLAGS_serve_lora_capacity", 8,
     "multi-tenant LoRA serving: resident-adapter slots in the paged adapter "
     "arena (slot 0 is the pinned base-model passthrough on top of this).  "

@@ -188,6 +188,19 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
       `X-Idempotency-Replay`) within `FLAGS_router_idem_ttl`, an in-flight
       key joins the live generation — at most one generation per key even
       through connection resets and router failover
+    - POST /prefill           -> disaggregated prefill hop (engine-backed,
+      ISSUE 19): runs chunked prefill + ONE sampled token, exports the
+      committed prompt pages, and answers {"first_token", "prompt_len",
+      "handoff"} — the handoff payload a decode-role replica imports via
+      /generate's "handoff" field (paired with a "reservation" from
+      /reserve).  Quantized arenas ship int8 rows + scales as stored.
+      With "export": false (the router's single-token fast path) the page
+      export is skipped and "handoff" is null — the sampled token is the
+      entire response.
+    - POST /reserve           -> {"prompt_len": L, "max_new_tokens": n}
+      reserves decode-side pages BEFORE prefill starts elsewhere; answers
+      {"reservation", "pages", "ttl_s"} or typed 503 when the headroom
+      isn't there.  Unconsumed reservations expire after ttl_s.
 
     A ContinuousBatchingEngine serves /generate with true continuous
     batching: concurrent requests decode interleaved in the slot pool, each
@@ -383,6 +396,8 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
                                     else int(req["spec_k"])
                                 ),
                                 adapter=req.get("adapter"),
+                                handoff=req.get("handoff"),
+                                reservation=req.get("reservation"),
                             )
                         )
                 except AdapterUnknown as e:
@@ -416,6 +431,118 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
             except engine_mod.DeadlineExceeded as e:
                 # the deadline passed while queued/decoding: retrying the
                 # same budget elsewhere cannot succeed
+                self._reply_error(504, type(e).__name__, str(e), False)
+            except engine_mod.NonFiniteLogits as e:
+                self._reply_error(500, type(e).__name__, str(e), False)
+            except Exception as e:
+                self._reply_error(
+                    400, type(e).__name__, f"{type(e).__name__}: {e}", False
+                )
+
+        def _reserve_engine(self):
+            # decode-side page hold, taken BEFORE prefill starts elsewhere:
+            # the router reserves here, prefills on the prefill worker, then
+            # spends the reservation in /generate's admission — so a prefill
+            # never completes into a decode worker that can't seat it
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n))
+                out = engine.reserve_pages(
+                    int(req["prompt_len"]),
+                    int(req.get("max_new_tokens") or 32),
+                    ttl_s=(
+                        None if req.get("ttl_s") is None
+                        else float(req["ttl_s"])
+                    ),
+                )
+                self._reply(200, out)
+            except EngineUnavailable as e:
+                self._busy(str(e), retry_after=e.retry_after_s,
+                           err_type=type(e).__name__)
+            except Exception as e:
+                self._reply_error(
+                    400, type(e).__name__, f"{type(e).__name__}: {e}", False
+                )
+
+        def _prefill_engine(self):
+            from ..fault import injection as _inj
+
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n))
+                ids = req["input_ids"]
+                if ids and isinstance(ids[0], list):
+                    self._reply_error(
+                        400, "ValueError",
+                        "/prefill takes one prompt per request", False,
+                    )
+                    return
+                deadline_s = self._deadline_s(req)
+                if deadline_s is not None and deadline_s <= 0:
+                    self._reply_error(
+                        504, "DeadlineExceeded",
+                        "deadline exhausted before admission", False,
+                    )
+                    return
+                # export=false is the router's single-token fast path: the
+                # sampled token is the entire response, so no page export
+                # (and no handoff) is ever built
+                want_export = bool(req.get("export", True))
+                try:
+                    # one sampled token: the decode side seats pos=L with
+                    # this token as its first emission, so the handoff is
+                    # exactly a colocated engine's post-prefill state.
+                    # spec_k=0 — a 1-token request has nothing to draft.
+                    h = engine.submit(
+                        ids,
+                        max_new_tokens=1,
+                        temperature=float(req.get("temperature", 0.0)),
+                        eos_token_id=req.get("eos_token_id"),
+                        deadline_s=deadline_s,
+                        trace=(self._trace_id, self._handle_sid),
+                        spec_k=0,
+                        export_kv=want_export,
+                    )
+                except engine_mod.DeadlineUnattainable as e:
+                    self._reply_error(
+                        504, type(e).__name__, str(e), True, e.retry_after_s
+                    )
+                    return
+                except EngineUnavailable as e:
+                    self._busy(str(e), retry_after=e.retry_after_s,
+                               err_type=type(e).__name__)
+                    return
+                out = h.wait(timeout=600)
+                if _inj.should_fire("disagg.prefill.crash", "serve./prefill"):
+                    # kill -9 mid-handoff: the payload exists server-side
+                    # but not one response byte leaves, so the router sees
+                    # a transport error with response_started=False — a
+                    # zero-token retriable failover, never a duplicate
+                    self.close_connection = True
+                    return
+                if not want_export:
+                    self._reply(200, {
+                        "first_token": int(out[len(ids)]),
+                        "prompt_len": len(ids),
+                        "handoff": None,
+                    })
+                    return
+                if h.kv_export is None:
+                    self._reply_error(
+                        503, "HandoffExportFailed",
+                        "prefill finished but the page export failed; retry",
+                        True,
+                    )
+                    return
+                payload = h.kv_export
+                self._reply(200, {
+                    "first_token": payload.get("first_token"),
+                    "prompt_len": payload["prompt_len"],
+                    "handoff": payload,
+                })
+            except engine_mod.EngineRestarted as e:
+                self._busy(str(e), err_type=type(e).__name__)
+            except engine_mod.DeadlineExceeded as e:
                 self._reply_error(504, type(e).__name__, str(e), False)
             except engine_mod.NonFiniteLogits as e:
                 self._reply_error(500, type(e).__name__, str(e), False)
@@ -481,6 +608,12 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
                         return
                     self._idem_key = key  # first sight: generate, then cache
                 self._generate_engine()
+                return
+            if self.path == "/prefill" and engine is not None:
+                self._prefill_engine()
+                return
+            if self.path == "/reserve" and engine is not None:
+                self._reserve_engine()
                 return
             if self.path == "/generate" and isinstance(predictor, GenerationPredictor):
                 if not gate.acquire(blocking=False):

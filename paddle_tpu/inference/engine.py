@@ -243,6 +243,15 @@ class EngineRequest:
         self.state = "queued"  # live phase; finish_reason once terminal
         self.cancelled = False
         self.error = None
+        # disaggregated serving (ISSUE 19): export_kv asks the engine to
+        # read the committed prompt pages into kv_export at finish; handoff
+        # is the (deserialized layers, first_token) pair a decode-role
+        # engine imports instead of prefilling; reservation names the
+        # decode-side page hold this admission consumes
+        self.export_kv = False
+        self.kv_export = None
+        self.handoff = None
+        self.reservation = None
         self.ttft_s = None
         self._submit_t = None
         self._deadline_t = None  # absolute perf_counter deadline
@@ -297,7 +306,7 @@ class ContinuousBatchingEngine:
     def __init__(self, model, slots=None, max_len=None, prefill_buckets=None,
                  queue_depth=None, seed=0, paged=None, page_size=None,
                  pool_pages=None, prefix_cache=None, spec_k=None, lora=None,
-                 decode_kernel=None, tp=None, kv_quant=None):
+                 decode_kernel=None, tp=None, kv_quant=None, role=None):
         import jax
 
         from .. import jit, to_tensor
@@ -377,6 +386,24 @@ class ContinuousBatchingEngine:
             else kv_quant,
             paged=self.paged,
         )
+        # disaggregated serving (ISSUE 19): the role decides which side of
+        # the paged-KV handoff this engine plays.  'prefill' exports its
+        # committed prompt pages at finish; 'decode' grows ONE extra
+        # compiled executable (the page-import scatter) and accepts
+        # handoff submissions; 'colocated' is the classic single-box
+        # engine with an unchanged compiled budget.
+        self.role = str(
+            _fcore.flag("FLAGS_serve_role") if role is None else role
+        ).strip().lower()
+        if self.role not in ("colocated", "prefill", "decode"):
+            raise ValueError(
+                f"role must be colocated|prefill|decode, got {self.role!r}"
+            )
+        if self.role != "colocated" and not self.paged:
+            raise ValueError(
+                f"role={self.role!r} requires the paged engine: the "
+                "prefill->decode handoff rides the page arenas"
+            )
         if self.paged:
             ps = int(
                 page_size if page_size is not None
@@ -480,10 +507,25 @@ class ContinuousBatchingEngine:
             self._prefill_fn = jit.to_static(self._prefill_paged_body)
             self._chunk_fn = jit.to_static(self._chunk_prefill_body)
             self._copy_fn = jit.to_static(self._copy_page_body)
+            # handoff geometry, captured once: submit() validates incoming
+            # payloads against it and the exporter stamps it on the wire
+            self._kv_heads = int(cfg.num_key_value_heads)
+            self._head_dim = int(head_dim)
+            self._kv_dtype_np = np.dtype(_fcore.to_jax_dtype(cache_dtype))
+            # the import scatter is built ONLY for decode-role engines, so
+            # colocated/prefill compile_counts() keep their exact dict shape
+            self._import_fn = (
+                jit.to_static(
+                    self._import_page_q8_body if self.kv_quant == "int8"
+                    else self._import_page_body
+                )
+                if self.role == "decode" else None
+            )
         else:
             self._arenas = None
             self._pool = None
             self._prefix = None
+            self._import_fn = None
             self.decode_kernel = "auto"  # dense engines have no paged path
             self._caches = [
                 StaticKVCache(self.slots, self.max_len, cfg.num_key_value_heads,
@@ -555,6 +597,13 @@ class ContinuousBatchingEngine:
         self._requeue = []  # restart-recovered requests, ahead of the queue
         self._queued_new_tokens = 0  # tokens owed to queued+requeued work
         self._admitting = None  # request between queue-pop and slot landing
+        # disaggregated page reservations (ISSUE 19): rid -> (pages, expiry).
+        # A reservation is a PLAIN COUNTER against fresh-allocation headroom,
+        # never a fake pool refcount — the page-invariant audit demands refs
+        # equal observable holds exactly.  Expired entries are purged every
+        # scheduler tick (TTL covers a router that died mid-handoff).
+        self._reserved = {}
+        self._reserved_pages = 0
         self._cv = threading.Condition()
         self._mu = threading.RLock()  # slot table / device state / requeue
         self._thread = None
@@ -873,11 +922,62 @@ class ContinuousBatchingEngine:
                 )._data
         return dst
 
+    def _import_page_body(self, k_tiles, v_tiles, dst):
+        """Disaggregated handoff import (ISSUE 19): land ONE page's worth of
+        prompt K/V rows — shipped by a prefill worker — into arena page
+        `dst` across every layer, in one compiled dispatch.  `k_tiles` /
+        `v_tiles` are `[n_layers, page_size, kv_heads, head_dim]` stacks
+        (partial last pages arrive zero-padded; padded rows sit past the
+        slot's pos, masked like any other garbage row) and `dst` a scalar
+        int32 — ALL data, so the decode worker imports any number of
+        handoffs through this single executable with zero recompiles."""
+        from ..ops.dispatch import apply
+
+        for i, a in enumerate(self._arenas):
+            a.k._data = apply(
+                lambda c, t, d_, _i=i: c.at[d_].set(t[_i]),
+                [a.k, k_tiles, dst], name="kv_page_import",
+            )._data
+            a.v._data = apply(
+                lambda c, t, d_, _i=i: c.at[d_].set(t[_i]),
+                [a.v, v_tiles, dst], name="kv_page_import",
+            )._data
+        return dst
+
+    def _import_page_q8_body(self, k_tiles, v_tiles, k_scale_tiles,
+                             v_scale_tiles, dst):
+        """`_import_page_body` for an int8 arena: the handoff ships the
+        quantized rows AS STORED plus their float32 scale rows
+        (`[n_layers, page_size, kv_heads, 1]` stacks), so the import writes
+        bit-identical arena state — no requantization, no drift, and the
+        wire pays int8 prices (~2x cheaper than the cache dtype)."""
+        from ..ops.dispatch import apply
+
+        for i, a in enumerate(self._arenas):
+            a.k._data = apply(
+                lambda c, t, d_, _i=i: c.at[d_].set(t[_i]),
+                [a.k, k_tiles, dst], name="kv_page_import",
+            )._data
+            a.v._data = apply(
+                lambda c, t, d_, _i=i: c.at[d_].set(t[_i]),
+                [a.v, v_tiles, dst], name="kv_page_import",
+            )._data
+            a.k_scale._data = apply(
+                lambda c, t, d_, _i=i: c.at[d_].set(t[_i]),
+                [a.k_scale, k_scale_tiles, dst], name="kv_page_import",
+            )._data
+            a.v_scale._data = apply(
+                lambda c, t, d_, _i=i: c.at[d_].set(t[_i]),
+                [a.v_scale, v_scale_tiles, dst], name="kv_page_import",
+            )._data
+        return dst
+
     # -- public API ---------------------------------------------------------
 
     def submit(self, input_ids, max_new_tokens=32, temperature=0.0,
                eos_token_id=None, on_token=None, deadline_s=None,
-               trace=None, spec_k=None, adapter=None):
+               trace=None, spec_k=None, adapter=None, export_kv=False,
+               handoff=None, reservation=None):
         """Enqueue one request (1-D token ids).  Returns an EngineRequest
         handle immediately; raises QueueFull when the admission queue is at
         capacity, DeadlineUnattainable when `deadline_s` cannot beat the
@@ -888,8 +988,16 @@ class ContinuousBatchingEngine:
         `adapter` names a registered LoRA adapter (name or stable id; None
         or 0 = base model) — AdapterUnknown propagates for unregistered
         names, so clients see the typed 404 before the request ever
-        queues."""
+        queues.  Disaggregated serving (ISSUE 19): `export_kv=True` makes
+        a paged engine read the request's committed prompt pages into a
+        handoff payload (`req.kv_export`) when it finishes; `handoff`
+        carries such a payload INTO a decode-role engine — the prompt's KV
+        is imported through the compiled page scatter instead of
+        prefilled, and the payload's first token becomes the request's
+        first emitted token.  `reservation` names a reserve_pages() hold
+        this admission consumes."""
         from .. import profiler as _prof
+        from .paging import HandoffFormatError, deserialize_kv_handoff
 
         ids = np.asarray(input_ids, np.int32).reshape(-1)
         if ids.size == 0:
@@ -919,6 +1027,43 @@ class ContinuousBatchingEngine:
                     f"adapter {adapter_obj.name!r} rank {adapter_obj.rank} "
                     f"exceeds the arena rank_max {self._lora.rank_max}"
                 )
+        if export_kv and not self.paged:
+            raise ValueError(
+                "export_kv requires the paged engine (the handoff payload "
+                "is the committed page rows)"
+            )
+        handoff_state = None
+        if handoff is not None:
+            # typed validation BEFORE the request queues: wrong role,
+            # foreign arena geometry, or corrupt rows must surface as a
+            # client error, never inside a compiled step
+            if not (self.paged and self.role == "decode"):
+                raise ValueError(
+                    "handoff import requires a paged engine in the 'decode' "
+                    f"role (this engine: paged={self.paged}, "
+                    f"role={self.role!r})"
+                )
+            if adapter_obj is not None:
+                raise ValueError(
+                    "handoff requests cannot name a LoRA adapter: the "
+                    "prefill worker's exported KV embeds no adapter deltas"
+                )
+            layers, hL = deserialize_kv_handoff(
+                handoff, self.kv_quant, self._kv_heads, self._head_dim,
+                len(self._arenas), self._kv_dtype_np.name,
+            )
+            if hL != int(ids.size):
+                raise HandoffFormatError(
+                    f"handoff prompt_len {hL} != submitted prompt length "
+                    f"{int(ids.size)}"
+                )
+            first_tok = handoff.get("first_token")
+            if first_tok is None:
+                raise HandoffFormatError(
+                    "handoff payload missing first_token (the prefill "
+                    "worker's sampled token)"
+                )
+            handoff_state = (layers, int(first_tok))
         if self._dead:
             raise EngineUnavailable(
                 "engine is dead (restart budget exhausted); restart the server"
@@ -959,6 +1104,9 @@ class ContinuousBatchingEngine:
             eos_token_id, on_token, deadline_s=deadline_s, trace=trace,
             spec_k=spec_k, adapter=adapter_obj,
         )
+        req.export_kv = bool(export_kv)
+        req.handoff = handoff_state
+        req.reservation = None if reservation is None else str(reservation)
         req._submit_t = time.perf_counter()
         if deadline_s is not None:
             req._deadline_t = req._submit_t + float(deadline_s)
@@ -1020,6 +1168,27 @@ class ContinuousBatchingEngine:
             self._copy_fn(  # scratch onto itself: a no-op through the real fn
                 to_tensor(np.int32(0)), to_tensor(np.int32(0))
             )
+            if self._import_fn is not None:
+                # decode role: warm the handoff import scatter with zero
+                # tiles aimed at scratch page 0 (already zeros — a no-op
+                # through the real executable, like the copy warm above)
+                nl = len(self._arenas)
+                elem = (
+                    np.dtype(np.int8) if self.kv_quant == "int8"
+                    else self._kv_dtype_np
+                )
+                tile = (nl, self.page_size, self._kv_heads, self._head_dim)
+                args = [
+                    to_tensor(np.zeros(tile, elem)),
+                    to_tensor(np.zeros(tile, elem)),
+                ]
+                if self.kv_quant == "int8":
+                    srow = (nl, self.page_size, self._kv_heads, 1)
+                    args += [
+                        to_tensor(np.ones(srow, np.float32)),
+                        to_tensor(np.ones(srow, np.float32)),
+                    ]
+                self._import_fn(*args, to_tensor(np.int32(0)))
             _, _, _, self._key = self._decode_fn(
                 to_tensor(np.zeros((self.slots, 1), np.int32)),
                 to_tensor(np.zeros(self.slots, np.int32)),
@@ -1086,6 +1255,11 @@ class ContinuousBatchingEngine:
             out["chunk_prefill"] = self._chunk_fn.trace_count
             out["copy"] = self._copy_fn.trace_count
             out["aot_hits"] += self._chunk_fn.aot_hits + self._copy_fn.aot_hits
+        if self._import_fn is not None:
+            # decode role only (ISSUE 19): the handoff import scatter is one
+            # executable forever — payload churn is data
+            out["import"] = self._import_fn.trace_count
+            out["aot_hits"] += self._import_fn.aot_hits
         if self._spec_on:
             out["verify"] = self._verify_fn.trace_count
             out["aot_hits"] += self._verify_fn.aot_hits
@@ -1159,7 +1333,12 @@ class ContinuousBatchingEngine:
             status = "live"
         if self.paged:
             usable = max(1, self._pool.usable_pages)
-            page_free = self._pool.free_count() / usable
+            # live reservations are spoken-for headroom: the router's
+            # decode-side scoring must see pages a pending handoff will
+            # consume as already gone, or it over-admits into the gap
+            page_free = max(
+                0, self._pool.free_count() - self._reserved_pages
+            ) / usable
         else:
             page_free = (self.slots - self.active_slots) / self.slots
         ew = self._step_ewma_s
@@ -1187,6 +1366,11 @@ class ContinuousBatchingEngine:
             # this replica's own usable pages, so router scoring needs no
             # mode awareness
             "kv_quant": self.kv_quant,
+            # disaggregated serving (ISSUE 19): the fleet role this replica
+            # plays, plus the pages currently spoken for by un-consumed
+            # handoff reservations — the router's pair-pick reads both
+            "role": self.role,
+            "reserved_pages": int(self._reserved_pages),
             # mesh topology (ISSUE 14): degree + axis shape so a fleet
             # operator can see which replicas are TP-sharded from /healthz
             "tp": self.tp,
@@ -1203,6 +1387,75 @@ class ContinuousBatchingEngine:
             lora["adapters"] = self._lora.resident()
             out["lora"] = lora
         return out
+
+    # -- disaggregated handoff: page reservations (ISSUE 19) -----------------
+
+    def reserve_pages(self, prompt_len, max_new_tokens, ttl_s=None):
+        """Reserve decode-side page headroom for a handoff BEFORE prefill
+        starts, so a finished prefill can never strand with nowhere to
+        land.  Returns {"reservation", "pages", "ttl_s"}; raises QueueFull
+        (503 family) when the worst-case page need exceeds the current
+        fresh headroom.  The hold is a counter against admission headroom —
+        it pins no specific pages and takes no pool refs — and it expires
+        after `ttl_s` (FLAGS_serve_reserve_ttl_s default): a router that
+        dies mid-handoff just lets the TTL return the headroom."""
+        if not self.paged:
+            raise EngineUnavailable(
+                "page reservations require the paged engine"
+            )
+        if self._dead:
+            raise EngineUnavailable(
+                "engine is dead (restart budget exhausted); restart the server"
+            )
+        if self._draining:
+            raise EngineUnavailable(
+                "engine is draining (shutdown in progress)",
+                retry_after_s=self.estimate_drain_s(),
+            )
+        need = self._pages_for(int(prompt_len), int(max_new_tokens))
+        ttl = float(
+            _fcore.flag("FLAGS_serve_reserve_ttl_s") if ttl_s is None
+            else ttl_s
+        )
+        with self._mu:
+            self._purge_reservations_locked()
+            if need > self._page_fresh_headroom_locked(()):
+                raise QueueFull(
+                    f"cannot reserve {need} KV pages (prompt {prompt_len} + "
+                    f"max_new {max_new_tokens}): only "
+                    f"{max(0, self._page_fresh_headroom_locked(()))} "
+                    "unreserved pages of headroom",
+                    retry_after_s=self.estimate_drain_s(),
+                )
+            rid = f"rsv-{next(self._rsv_ids)}"
+            self._reserved[rid] = (need, time.perf_counter() + ttl)
+            self._reserved_pages += need
+        _flight.record("disagg", "reserve", rid=rid, pages=need)
+        return {"reservation": rid, "pages": int(need), "ttl_s": ttl}
+
+    _rsv_ids = itertools.count(1)  # reservation ids unique across engines
+
+    def _purge_reservations_locked(self, now=None):
+        """Drop expired reservations, returning their headroom.  Caller
+        holds _mu."""
+        if not self._reserved:
+            return
+        now = time.perf_counter() if now is None else now
+        for rid in [r for r, (_, exp) in self._reserved.items() if exp <= now]:
+            n, _exp = self._reserved.pop(rid)
+            self._reserved_pages -= n
+            _flight.record("disagg", "reserve_expired", rid=rid, pages=n)
+
+    def _consume_reservation_locked(self, rid):
+        """Release one reservation (the admission it covered is here, or
+        the router abandoned it).  Idempotent — an unknown/expired rid is
+        a no-op, the request simply competes for headroom unreserved.
+        Caller holds _mu."""
+        ent = self._reserved.pop(str(rid), None)
+        if ent is None:
+            return False
+        self._reserved_pages -= ent[0]
+        return True
 
     # -- scheduler ----------------------------------------------------------
 
@@ -1546,7 +1799,11 @@ class ContinuousBatchingEngine:
                 1 for e in self._prefix.entries()
                 if self._pool.refs[e.page] == 1 and e.page not in exclude
             )
-        return free
+        # un-consumed handoff reservations (ISSUE 19) are spoken for: fresh
+        # allocations for anyone else must leave them covered.  A handoff
+        # admission consumes its own reservation BEFORE this check, so the
+        # hold converts into exactly the headroom it promised.
+        return free - self._reserved_pages
 
     def _alloc_page_locked(self):
         """One fresh page, evicting LRU prefix-cache entries under pressure.
@@ -1596,6 +1853,8 @@ class ContinuousBatchingEngine:
         with self._mu:
             self._check_gen(gen)
             now = time.perf_counter()
+            if self.paged:
+                self._purge_reservations_locked(now)
             victims = []
             for s, req in enumerate(self._slot_req):
                 if req is None:
@@ -1657,6 +1916,13 @@ class ContinuousBatchingEngine:
                 if req is None:
                     break
                 if self.paged:
+                    # a handoff admission consumes its reservation FIRST:
+                    # inside this same critical section the returned
+                    # headroom flows straight into the check below, so the
+                    # hold converts into the pages it promised (ISSUE 19)
+                    if req.reservation is not None:
+                        self._consume_reservation_locked(req.reservation)
+                        req.reservation = None
                     # prefix-aware admission: pages a cache hit will map by
                     # incref cost no fresh allocation, so only the unshared
                     # remainder counts against headroom — this is what lets
@@ -1666,9 +1932,11 @@ class ContinuousBatchingEngine:
                     # thread is the only inserter/evictor, so the match
                     # cannot shrink in between.  Matched pages are excluded
                     # from the evictable count — they are about to be pinned.
+                    # Handoff imports always land ALL pages fresh (they
+                    # commit to the cache after, so future prompts share).
                     need = self._pages_for(req.prompt.size, req.max_new_tokens)
                     exclude = ()
-                    if self._prefix is not None:
+                    if self._prefix is not None and req.handoff is None:
                         m, fulls, tail, _rows = self._prefix.lookup(
                             req.prompt, adapter=self._req_adapter_id(req)
                         )
@@ -1727,6 +1995,8 @@ class ContinuousBatchingEngine:
         return emitted
 
     def _prefill_into(self, s, req, gen):
+        if self.paged and req.handoff is not None:
+            return self._import_into_paged(s, req, gen)
         if self.paged:
             return self._prefill_into_paged(s, req, gen)
         from .. import to_tensor
@@ -1935,6 +2205,108 @@ class ContinuousBatchingEngine:
                 parent_id=req.trace[1], req=req.id, bucket=bucket, slot=s,
                 prefix_match=match_len or None,
                 adapter=req.adapter.name if req.adapter is not None else None,
+            )
+
+    def _import_into_paged(self, s, req, gen):
+        """Disaggregated admission (ISSUE 19): the prompt's KV arrives in
+        `req.handoff` instead of being prefilled.  Maps fresh pages, lands
+        the shipped rows page-by-page through the compiled import scatter
+        (one executable, payload is data), commits the prompt pages to the
+        prefix cache so FUTURE identical prompts share them, then seats the
+        slot exactly like a prefill landing: pos = L, last_tok = the
+        prefill worker's sampled first token.  Greedy continuation is
+        bit-identical to a colocated engine at the same seed — same weights
+        and identical arena rows leave the decode step nothing to differ
+        on."""
+        from .. import profiler as _prof
+        from .. import to_tensor
+
+        ps = self.page_size
+        L = int(req.prompt.size)
+        layers, first_tok = req.handoff
+        n_prompt_pages = -(-L // ps)
+        with self._mu:
+            self._check_gen(gen)
+            self._flush_pending_locked()
+            req.max_new_tokens = min(req.max_new_tokens, self.max_len - L)
+            coverage = self._pages_for(L, req.max_new_tokens)
+            pages = [self._alloc_page_locked() for _ in range(coverage)]
+            self._page_table[s, :] = 0
+            self._page_table[s, : len(pages)] = pages
+            self._slot_pages[s] = list(pages)
+        t_pf = time.perf_counter()
+        if req.trace:
+            _obs.record("engine.queue", req.trace[0], t0=req._submit_t,
+                        t1=t_pf, parent_id=req.trace[1], req=req.id)
+        nl = len(self._arenas)
+        q8 = self.kv_quant == "int8"
+        elem = np.dtype(np.int8) if q8 else self._kv_dtype_np
+        kvh, hd = self._kv_heads, self._head_dim
+        # dispatch OUTSIDE the mutex (same contract as the prefill paths):
+        # the armed region must not block submitters or a restart
+        with self._watchdog.arm(
+            "serve.import", timeout=self._wd_timeout(),
+            context=f"req {req.id} ({n_prompt_pages} pages)",
+        ):
+            # a restart during a wedged import owns this request (and
+            # released the pages we just mapped) — bail before writing
+            self._check_gen(gen)
+            for i in range(n_prompt_pages):
+                lo, hi = i * ps, min(L, (i + 1) * ps)
+                rows = hi - lo
+                kt = np.zeros((nl, ps, kvh, hd), elem)
+                vt = np.zeros((nl, ps, kvh, hd), elem)
+                for li, ly in enumerate(layers):
+                    kt[li, :rows] = ly["k"][lo:hi]
+                    vt[li, :rows] = ly["v"][lo:hi]
+                args = [to_tensor(kt), to_tensor(vt)]
+                if q8:
+                    # padding rows carry scale 1.0, never 0: they sit past
+                    # the slot's pos and are position-masked, but their
+                    # dequantized values still flow through the masked
+                    # attention sum and must stay finite
+                    kst = np.ones((nl, ps, kvh, 1), np.float32)
+                    vst = np.ones((nl, ps, kvh, 1), np.float32)
+                    for li, ly in enumerate(layers):
+                        kst[li, :rows] = ly["k_scale"][lo:hi]
+                        vst[li, :rows] = ly["v_scale"][lo:hi]
+                    args += [to_tensor(kst), to_tensor(vst)]
+                self._import_fn(*args, to_tensor(np.int32(pages[i])))
+        with self._mu:
+            self._check_gen(gen)  # a restart while we imported owns req now
+            if self._prefix is not None:
+                inserted = self._prefix.commit(
+                    req.prompt, pages, self._pool,
+                    adapter=self._req_adapter_id(req),
+                )
+                if inserted:
+                    _prof.record_paging_event("cache_commits", inserted)
+            req.ttft_s = time.perf_counter() - req._submit_t
+            self._slot_req[s] = req
+            self._pos[s] = L
+            self._last_tok[s] = first_tok
+            self._temps[s] = req.temperature
+            self._slot_adapter[s] = 0  # handoffs never carry an adapter
+            if self._spec_on and req.temperature == 0.0 and (
+                req.spec_k is None or req.spec_k > 0
+            ):
+                self._drafters[s] = NgramDrafter(self._spec_ngram).reset(
+                    [int(t) for t in req.prompt] + [first_tok]
+                )
+            else:
+                self._drafters[s] = None
+            req.state = "decoding"
+            req.handoff = None  # the arena owns the rows now; free the copy
+            self._obs_epoch_close()
+            self._dev = None  # membership changed: rebuild device loop state
+            _prof.record_disagg_event("imports")
+            _prof.record_disagg_event("import_pages", n_prompt_pages)
+            self._emit(s, req, first_tok)
+        if req.trace:
+            _obs.record(
+                "engine.import", req.trace[0], t0=t_pf,
+                t1=time.perf_counter(), parent_id=req.trace[1], req=req.id,
+                slot=s, pages=n_prompt_pages,
             )
 
     def _decode_once(self, gen):
@@ -2306,6 +2678,21 @@ class ContinuousBatchingEngine:
             self._finish(s, req, "length")
 
     def _finish(self, s, req, reason):
+        if (
+            self.paged and req.export_kv and req.kv_export is None
+            and reason in ("eos", "length")
+        ):
+            # disaggregated prefill (ISSUE 19): read the committed prompt
+            # pages into the handoff payload NOW, while the slot still maps
+            # them — one line down they return to the pool
+            try:
+                self._export_slot_locked(s, req)
+            except Exception:
+                # the handoff consumer sees kv_export None and fails the
+                # hop; the pages must still be released below
+                logger.exception(
+                    "disagg: page export failed for request %d", req.id
+                )
         # recycle immediately: no cache scrub needed — the slot's next
         # prefill overwrites rows [0, bucket) and decode masks the rest
         self._slot_req[s] = None
@@ -2325,6 +2712,53 @@ class ContinuousBatchingEngine:
         self._obs_epoch_close()
         self._dev = None  # membership changed: rebuild device loop state
         self._resolve(req, reason)
+
+    def _export_slot_locked(self, s, req):
+        """Read slot `s`'s committed prompt rows — [0, L) of every layer's
+        K/V through its page mapping — into a serialized handoff payload on
+        `req.kv_export` (ISSUE 19).  Exactly the rows a colocated engine
+        would hold after this prompt's prefill: the first generated token's
+        KV is NOT yet written (it lands when the decode side feeds it back
+        at position L), so export-at-finish of a max_new_tokens=1 prefill
+        is the complete, sufficient handoff.  Rows ship as stored (int8 +
+        scale rows under kv_quant='int8').  Caller holds _mu."""
+        from .. import profiler as _prof
+        from .paging import serialize_kv_handoff
+
+        ps = self.page_size
+        L = int(req.prompt.size)
+        n_pages = -(-L // ps)
+        idx = np.asarray(self._slot_pages[s][:n_pages], np.int64)
+        layers = []
+        with _san.allowed_sync("disagg page export"):
+            for a in self._arenas:
+                ly = {
+                    "k": np.asarray(a.k.numpy())[idx].reshape(
+                        n_pages * ps, self._kv_heads, self._head_dim
+                    )[:L],
+                    "v": np.asarray(a.v.numpy())[idx].reshape(
+                        n_pages * ps, self._kv_heads, self._head_dim
+                    )[:L],
+                }
+                if a.k_scale is not None:
+                    ly["k_scale"] = np.asarray(a.k_scale.numpy())[idx].reshape(
+                        n_pages * ps, self._kv_heads, 1
+                    )[:L]
+                    ly["v_scale"] = np.asarray(a.v_scale.numpy())[idx].reshape(
+                        n_pages * ps, self._kv_heads, 1
+                    )[:L]
+                layers.append(ly)
+        payload = serialize_kv_handoff(
+            layers, L, self.kv_quant, self._kv_dtype_np.name
+        )
+        payload["first_token"] = int(req.tokens[0]) if req.tokens else None
+        req.kv_export = payload
+        _prof.record_disagg_event("exports")
+        _prof.record_disagg_event("handoff_bytes", payload["payload_bytes"])
+        _flight.record(
+            "disagg", "export", req=req.id, pages=n_pages,
+            bytes=payload["payload_bytes"],
+        )
 
     def _resolve(self, req, reason):
         """Terminal transition, exactly once: a request that already

@@ -40,6 +40,8 @@ debug-invariants check.
 
 from __future__ import annotations
 
+import base64
+
 import numpy as np
 
 
@@ -196,6 +198,144 @@ def check_table_bounds(table, num_pages):
             f"page table entries out of arena bounds [0, {int(num_pages)}): "
             f"min={lo}, max={hi}, first bad index={bad[0].tolist()}"
         )
+
+
+# ---------------------------------------------------------------------------
+# Disaggregated prefill/decode handoff wire format (ISSUE 19).  A prefill
+# worker ships the COMMITTED prompt rows of every layer's K/V arena to a
+# decode worker as ROW payloads — `[L, kv_heads, head_dim]` per layer, raw
+# little-endian bytes, base64 for the JSON hop — deliberately page-size
+# agnostic so the two sides may run different page geometries.  Under
+# kv_quant='int8' the rows ship AS STORED (int8 elements + the float32
+# per-row/per-head scale rows from the parallel scale arena), so handoff
+# bytes get the same ~2x saving the arena gets and the decode side imports
+# bit-identical quantized rows: no re-quantization, no drift.
+# ---------------------------------------------------------------------------
+
+HANDOFF_VERSION = 1
+
+
+class HandoffFormatError(ValueError):
+    """Raised when a handoff payload cannot be imported by the receiving
+    decode engine — wrong version, mismatched quant mode / KV geometry /
+    layer count, or corrupt row bytes.  Typed so the serving layer can map
+    it to a 4xx instead of crashing a compiled step (same contract as
+    QuantConfigError above)."""
+
+
+def _np_dtype(name):
+    """np.dtype for a cache dtype name, covering the ml_dtypes extension
+    types (bfloat16 etc.) that plain numpy doesn't parse."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _b64(arr):
+    return base64.b64encode(np.ascontiguousarray(arr).tobytes()).decode("ascii")
+
+
+def _unb64(s, dtype, shape, what):
+    buf = base64.b64decode(s.encode("ascii"))
+    want = int(np.prod(shape)) * dtype.itemsize
+    if len(buf) != want:
+        raise HandoffFormatError(
+            f"handoff {what}: {len(buf)} bytes, expected {want} for "
+            f"shape {tuple(shape)} dtype {dtype}"
+        )
+    return np.frombuffer(buf, dtype=dtype).reshape(shape).copy()
+
+
+def serialize_kv_handoff(layers, prompt_len, quant, dtype_name):
+    """Pack per-layer exported prompt rows into a JSON-safe handoff payload.
+
+    `layers` is a list (one per model layer) of dicts with 'k'/'v' arrays of
+    shape [L, kv_heads, head_dim] (int8 under quant='int8', else the cache
+    dtype) plus 'k_scale'/'v_scale' [L, kv_heads, 1] float32 when quantized.
+    Returns the payload dict; its 'payload_bytes' field counts the RAW row
+    bytes (pre-base64) — the number the bench and paddle_disagg_* metrics
+    report as handoff traffic."""
+    if not layers:
+        raise HandoffFormatError("handoff payload needs >= 1 layer")
+    L = int(prompt_len)
+    kvh, hd = int(layers[0]["k"].shape[1]), int(layers[0]["k"].shape[2])
+    quant = validate_kv_quant(quant)
+    raw = 0
+    packed = []
+    for ly in layers:
+        rec = {"k": _b64(ly["k"]), "v": _b64(ly["v"])}
+        raw += ly["k"].nbytes + ly["v"].nbytes
+        if quant == "int8":
+            rec["k_scale"] = _b64(ly["k_scale"])
+            rec["v_scale"] = _b64(ly["v_scale"])
+            raw += ly["k_scale"].nbytes + ly["v_scale"].nbytes
+        packed.append(rec)
+    return {
+        "version": HANDOFF_VERSION,
+        "prompt_len": L,
+        "quant": quant,
+        "kv_heads": kvh,
+        "head_dim": hd,
+        "n_layers": len(layers),
+        "dtype": str(dtype_name),
+        "payload_bytes": int(raw),
+        "layers": packed,
+    }
+
+
+def deserialize_kv_handoff(payload, quant, kv_heads, head_dim, n_layers, dtype_name):
+    """Unpack + validate a handoff payload against the RECEIVING engine's
+    arena geometry.  Returns (layers, prompt_len) where `layers` mirrors the
+    serialize_kv_handoff input layout.  Every mismatch is a typed
+    HandoffFormatError — the decode engine must never feed foreign-geometry
+    rows into its compiled import scatter."""
+    if not isinstance(payload, dict):
+        raise HandoffFormatError(f"handoff payload is {type(payload).__name__}, not a dict")
+    if int(payload.get("version", -1)) != HANDOFF_VERSION:
+        raise HandoffFormatError(
+            f"handoff version {payload.get('version')!r} != {HANDOFF_VERSION}"
+        )
+    quant = validate_kv_quant(quant)
+    for field, want in (
+        ("quant", quant),
+        ("kv_heads", int(kv_heads)),
+        ("head_dim", int(head_dim)),
+        ("n_layers", int(n_layers)),
+        ("dtype", str(dtype_name)),
+    ):
+        got = payload.get(field)
+        got = type(want)(got) if got is not None else got
+        if got != want:
+            raise HandoffFormatError(
+                f"handoff {field} mismatch: payload has {got!r}, "
+                f"this engine expects {want!r}"
+            )
+    L = int(payload.get("prompt_len", 0))
+    if L <= 0:
+        raise HandoffFormatError(f"handoff prompt_len {L} must be positive")
+    rows = payload.get("layers")
+    if not isinstance(rows, list) or len(rows) != int(n_layers):
+        raise HandoffFormatError(
+            f"handoff carries {len(rows) if isinstance(rows, list) else '?'} "
+            f"layer records, expected {int(n_layers)}"
+        )
+    elem = np.dtype(np.int8) if quant == "int8" else _np_dtype(dtype_name)
+    kvh, hd = int(kv_heads), int(head_dim)
+    out = []
+    for i, rec in enumerate(rows):
+        ly = {
+            "k": _unb64(rec["k"], elem, (L, kvh, hd), f"layer {i} k"),
+            "v": _unb64(rec["v"], elem, (L, kvh, hd), f"layer {i} v"),
+        }
+        if quant == "int8":
+            f32 = np.dtype(np.float32)
+            ly["k_scale"] = _unb64(rec["k_scale"], f32, (L, kvh, 1), f"layer {i} k_scale")
+            ly["v_scale"] = _unb64(rec["v_scale"], f32, (L, kvh, 1), f"layer {i} v_scale")
+        out.append(ly)
+    return out, L
 
 
 class PagePool:

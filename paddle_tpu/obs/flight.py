@@ -193,6 +193,13 @@ def dump(reason, path=None):
             kvq = _prof.kv_quant_summary()
             if kvq:
                 header["kv_quant"] = kvq
+            # disaggregated-serving traffic at death: "was this process on
+            # the handoff path, as which side, how many bytes crossed" —
+            # a mid-handoff post-mortem starts from these counters (the
+            # per-hop timeline rides the ring as 'disagg' events)
+            dis = _prof.disagg_summary()
+            if dis:
+                header["disagg"] = dis
             # kernel dispatch at death: "was the hot path on the Pallas
             # kernels or silently on the XLA fallback" — the perf
             # post-mortem's first question

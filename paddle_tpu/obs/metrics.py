@@ -47,6 +47,10 @@ Metric-name reference (the stable surface the scrape test pins):
     paddle_autoscaler_ticks_total / _scale_ups_total / _scale_downs_total
     paddle_autoscaler_holds_total / _spawn_failures_total / _reaps_total
     paddle_autoscaler_replicas / _replicas_peak
+    paddle_disagg_exports_total / _imports_total / _import_pages_total
+    paddle_disagg_handoff_bytes_total / _pair_picks_total
+    paddle_disagg_handoff_retries_total / _reserve_fails_total
+    paddle_disagg_no_decode_capacity_total
     paddle_mesh_devices / paddle_mesh_tp_degree
     paddle_mesh_allreduce_per_step
     paddle_kv_quant_mode{mode=...} 1
@@ -293,6 +297,20 @@ def render(labels=None):
             "fleet size under the autoscaler's control", "gauge")
     exp.add("paddle_autoscaler_replicas_peak", g.get("replicas_peak", 0),
             "peak fleet size under the autoscaler's control", "gauge")
+
+    g = snap.get("disagg", {})
+    for key, name in (
+        ("exports", "paddle_disagg_exports_total"),
+        ("imports", "paddle_disagg_imports_total"),
+        ("import_pages", "paddle_disagg_import_pages_total"),
+        ("handoff_bytes", "paddle_disagg_handoff_bytes_total"),
+        ("pair_picks", "paddle_disagg_pair_picks_total"),
+        ("handoff_retries", "paddle_disagg_handoff_retries_total"),
+        ("reserve_fails", "paddle_disagg_reserve_fails_total"),
+        ("no_decode_capacity", "paddle_disagg_no_decode_capacity_total"),
+    ):
+        exp.add(name, g.get(key, 0),
+                f"disaggregated prefill/decode serving events: {key}")
 
     # zero-filled label sets (like _FAULT_KINDS): a fallback regression must
     # show as a counter MOVING on a dashboard, not as a series appearing —

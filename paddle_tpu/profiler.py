@@ -297,6 +297,7 @@ def reset():
         _reset_lora_locked()
         _reset_router_locked()
         _reset_autoscale_locked()
+        _reset_disagg_locked()
         _reset_mesh_locked()
         _reset_kv_quant_locked()
         _flash_fallbacks.clear()
@@ -322,6 +323,7 @@ def metrics_snapshot():
             "lora": dict(_lora_gauges),
             "router": router,
             "autoscale": dict(_autoscale_gauges),
+            "disagg": dict(_disagg_gauges),
             "mesh": dict(_mesh_gauges),
             "kv_quant": dict(_kv_quant_gauges),
             "flash_fallbacks": dict(_flash_fallbacks),
@@ -746,6 +748,53 @@ def autoscale_summary():
     return g if g["ticks"] or g["scale_ups"] or g["scale_downs"] else {}
 
 
+# ---------------------------------------------------------------------------
+# Disaggregated serving gauges (ISSUE 19): every prefill->decode handoff
+# counted on both sides — exports/imports, raw handoff bytes on the wire,
+# router pair-picks, reservation failures, and the typed no-decode-capacity
+# sheds — so "is the handoff path healthy and what does it cost" is
+# answerable from profiler.summary() and /metrics.
+# ---------------------------------------------------------------------------
+
+_disagg_gauges = {
+    "exports": 0,        # prefill-side page exports completed
+    "imports": 0,        # decode-side handoff imports landed
+    "import_pages": 0,   # arena pages written by imports
+    "handoff_bytes": 0,  # raw (pre-base64) payload bytes exported
+    "pair_picks": 0,     # router (prefill, decode) pair selections
+    "handoff_retries": 0,  # zero-token failovers of the handoff pipeline
+    "reserve_fails": 0,  # decode-side reservation attempts that shed
+    "no_decode_capacity": 0,  # typed 503s when no decode worker had pages
+}
+
+
+def record_disagg_event(kind, n=1):
+    """Count one disaggregated-serving event: 'exports', 'imports',
+    'import_pages', 'handoff_bytes', 'pair_picks', 'handoff_retries',
+    'reserve_fails', 'no_decode_capacity' (unknown kinds are counted too so
+    call sites never have to guard)."""
+    with _counters_lock:
+        g = _disagg_gauges
+        g[kind] = g.get(kind, 0) + int(n)
+
+
+def _reset_disagg_locked():
+    for k in _disagg_gauges:
+        _disagg_gauges[k] = 0
+
+
+def reset_disagg():
+    with _counters_lock:
+        _reset_disagg_locked()
+
+
+def disagg_summary():
+    """Disaggregated-serving counters ({} until any handoff traffic)."""
+    with _counters_lock:
+        g = dict(_disagg_gauges)
+    return g if any(g.values()) else {}
+
+
 def _pctl(sorted_vals, q):
     if not sorted_vals:
         return 0.0
@@ -947,6 +996,18 @@ class Profiler:
                     t=asc["ticks"], up=asc["scale_ups"], dn=asc["scale_downs"],
                     sf=asc["spawn_failures"], n=asc["replicas"],
                     pk=asc["replicas_peak"],
+                )
+            )
+        dg = disagg_summary()
+        if dg:
+            print(
+                "disagg: {ex} exports  {im} imports ({pgs} pages)"
+                "  {by} handoff bytes  pair picks {pp}  retries {rt}"
+                "  reserve fails {rf}  no-capacity sheds {nc}".format(
+                    ex=dg["exports"], im=dg["imports"],
+                    pgs=dg["import_pages"], by=dg["handoff_bytes"],
+                    pp=dg["pair_picks"], rt=dg["handoff_retries"],
+                    rf=dg["reserve_fails"], nc=dg["no_decode_capacity"],
                 )
             )
         pg = paging_summary()

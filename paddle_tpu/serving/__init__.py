@@ -17,6 +17,7 @@ __all__ = [
     "RouterStandby",
     "NoReadyReplica",
     "RouterOverloaded",
+    "NoDecodeCapacity",
     "DeadlineExhausted",
     "serve_router",
     "Journal",
@@ -35,8 +36,8 @@ __all__ = [
 def __getattr__(name):
     if name in (
         "Router", "RouterError", "RouterCrashed", "RouterStandby",
-        "NoReadyReplica", "RouterOverloaded", "DeadlineExhausted",
-        "serve_router",
+        "NoReadyReplica", "RouterOverloaded", "NoDecodeCapacity",
+        "DeadlineExhausted", "serve_router",
     ):
         from . import router as _router
 

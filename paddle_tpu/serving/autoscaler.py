@@ -69,11 +69,21 @@ _SIGNAL_KEYS = (
 )
 
 
-def load_signals(snapshots):
+def load_signals(snapshots, role=None):
     """Fold per-replica probe snapshots into the fleet signal vector the
     control law reads.  Pure (unit-testable without a router): draining
     and down replicas count toward fleet size but not toward load — a
-    fleet of one dead replica reads as ready=0, which is pressure."""
+    fleet of one dead replica reads as ready=0, which is pressure.
+
+    `role` restricts the fold to one serving role's band (ISSUE 19): a
+    disaggregated fleet runs one controller per role, each scaling its
+    own slice on its own signals — prefill bands feel compute backlog,
+    decode bands feel page starvation — without double-counting the
+    other's replicas against its [min, max] band."""
+    if role is not None:
+        snapshots = [
+            s for s in snapshots if s.get("role", "colocated") == role
+        ]
     ready = [
         s for s in snapshots
         if s["state"] == "ready" and not s["admin_draining"]
@@ -189,7 +199,8 @@ class Autoscaler:
                  down_cooldown=None, up_drain_s=None, up_queue_depth=None,
                  up_miss_rate=None, min_page_free=None, down_drain_s=None,
                  down_min_idle_tokens_s=None, tp_max=None, devices_total=None,
-                 kv_heads=None, drain_grace=None, log_dir=None, journal=None):
+                 kv_heads=None, drain_grace=None, log_dir=None, journal=None,
+                 role=None):
         f = _core.flag
 
         def _pick(v, name, cast):
@@ -198,6 +209,10 @@ class Autoscaler:
         self.router = router
         self._spawn_fn = spawn_fn
         self._stop_fn = stop_fn
+        # disaggregated fleets (ISSUE 19) run ONE controller per role:
+        # this instance reads only its role's signals, drains only its
+        # role's replicas, and spawns workers booted into that role
+        self.role = None if role is None else str(role)
         self.min_replicas = _pick(min_replicas, "FLAGS_autoscale_min_replicas", int)
         self.max_replicas = _pick(max_replicas, "FLAGS_autoscale_max_replicas", int)
         if not 1 <= self.min_replicas <= self.max_replicas:
@@ -321,7 +336,9 @@ class Autoscaler:
     def _tick_locked(self, now):
         _prof.record_autoscale_event("ticks")
         self._reap_dead(now)
-        sig = load_signals([rep.snapshot() for rep in self.router.replicas])
+        sig = load_signals(
+            [rep.snapshot() for rep in self.router.replicas], role=self.role
+        )
         _prof.record_autoscale_replicas(sig["replicas"])
         want, reason = decide(sig, self.cfg)
         if want == "up":
@@ -502,6 +519,11 @@ class Autoscaler:
             s = rep.snapshot()
             if s["state"] != "ready" or s["admin_draining"]:
                 continue
+            if (
+                self.role is not None
+                and s.get("role", "colocated") != self.role
+            ):
+                continue
             cands.append((
                 0 if rep.rid in self._managed else 1,
                 s["queue_depth"] + s["active_slots"],
@@ -526,6 +548,8 @@ class Autoscaler:
             port = s.getsockname()[1]
         log_dir = self.log_dir or tempfile.mkdtemp(prefix="autoscale_log_")
         extra = ["--tp", str(tp)] if tp > 1 else []
+        if self.role is not None and self.role != "colocated":
+            extra += ["--role", self.role]
         proc = ReplicaProcess(
             index=100 + idx, port=port, log_dir=log_dir, extra_args=extra,
         ).start()

@@ -97,6 +97,11 @@ class Replica:
         self._tokens_per_step = 1.0
         self._deadline_miss_rate = 0.0
         self._lora_adapters = ()  # resident adapter names from healthz (ISSUE 12)
+        # disaggregated serving (ISSUE 19): the role the replica booted in
+        # (colocated/prefill/decode) and its decode-side reservation count,
+        # both folded from /healthz — pick_pair() routes on these
+        self._role = "colocated"
+        self._reserved_pages = 0
         self._probes_ok = 0
         self._probes_failed = 0
         # crash-proof front door (ISSUE 17): breaker transitions are
@@ -138,6 +143,8 @@ class Replica:
                 "tokens_per_step": self._tokens_per_step,
                 "deadline_miss_rate": self._deadline_miss_rate,
                 "lora_adapters": self._lora_adapters,
+                "role": self._role,
+                "reserved_pages": self._reserved_pages,
                 "probes_ok": self._probes_ok,
                 "probes_failed": self._probes_failed,
             }
@@ -312,6 +319,8 @@ class Replica:
             self._decode_ewma_ms = float(h.get("decode_ewma_ms", 0.0))
             self._tokens_per_step = float(h.get("tokens_per_step", 1.0))
             self._deadline_miss_rate = float(h.get("deadline_miss_rate", 0.0))
+            self._role = str(h.get("role", "colocated"))
+            self._reserved_pages = int(h.get("reserved_pages", 0))
             lora = h.get("lora")
             if isinstance(lora, dict):
                 self._lora_adapters = tuple(lora.get("adapters", ()))
@@ -340,6 +349,14 @@ class Replica:
         HTTP response — typed upstream errors come back as their status +
         JSON, the router decides on `retriable`.  Raises
         ReplicaTransportError when the connection dies."""
+        return self.post_json("/generate", payload, remaining_s=remaining_s,
+                              timeout=timeout, trace=trace, idem_key=idem_key)
+
+    def post_json(self, path, payload, remaining_s=None, timeout=None,
+                  trace=None, idem_key=None):
+        """One POST dispatch to `path` (the generalized transport behind
+        post_generate; the disaggregated pipeline's /reserve and /prefill
+        hops ride it with the same deadline/trace/exactly-once contract)."""
         from ..fault import injection as _inj
 
         # an armed router.replica.hang stands in for a wedged connection:
@@ -347,7 +364,7 @@ class Replica:
         _inj.inject_hang("router.replica.hang", context=self.rid)
         data = json.dumps(payload).encode()
         req = urllib.request.Request(
-            self.base_url + "/generate", data=data,
+            self.base_url + path, data=data,
             headers={"Content-Type": "application/json"},
         )
         if remaining_s is not None:
@@ -458,6 +475,14 @@ def main(argv=None):
              "satisfy the failover contract)",
     )
     p.add_argument(
+        "--role", default="colocated",
+        choices=("colocated", "prefill", "decode"),
+        help="disaggregated serving role (ISSUE 19): 'prefill' workers "
+             "answer /prefill with exported page payloads, 'decode' workers "
+             "import them via /generate handoffs (both force the paged "
+             "engine; 'colocated' is the classic do-everything replica)",
+    )
+    p.add_argument(
         "--kv-quant", default="none", choices=("none", "int8"),
         help="KV-cache storage precision (forces the paged engine): 'int8' "
              "stores K/V pages as int8 with per-row float32 scales, roughly "
@@ -496,6 +521,11 @@ def main(argv=None):
         # quantized arenas only exist on the paged engine; the flag opts
         # the replica into paging rather than erroring on the dense cache
         extra.update(paged=True, kv_quant=args.kv_quant)
+        extra.setdefault("page_size", 8)
+    if args.role != "colocated":
+        # disaggregated roles are page-handoff roles by definition: the
+        # wire format IS the page arena rows, so both ends must be paged
+        extra.update(paged=True, role=args.role)
         extra.setdefault("page_size", 8)
     eng = ContinuousBatchingEngine(
         model,

@@ -43,10 +43,23 @@ Routing contract:
   `/healthz` reports ready.  Zero dropped requests: the fleet keeps
   serving through the survivor(s).
 
+- **Disaggregated pipeline** (ISSUE 19): when the fleet has BOTH a ready
+  prefill-role and a ready decode-role replica, single-prompt adapterless
+  requests route through `/reserve` (decode pages held up front) ->
+  `/prefill` (chunked prefill + 1 token + page export) -> `/generate`
+  with the handoff payload on the decode worker.  `pick_pair()` scores
+  prefill workers on compute backlog and decode workers on page headroom;
+  zero-free-page decode workers are hard-skipped (typed
+  `NoDecodeCapacity` 503 when none is left).  Every hop failure before
+  decode bytes cross is a zero-token retriable failover — deterministic
+  prefill makes the retry's final tokens bit-identical — and abandoned
+  reservations expire by TTL on the decode side.
+
 Chaos: `router.replica.hang` wedges one dispatch (bounded by the HTTP
 timeout), `router.replica.flap` fails probes, `router.replica.kill`
-SIGKILLs a managed replica at probe time — all armed through the same
-`FLAGS_fault_inject` registry production uses.
+SIGKILLs a managed replica at probe time, `disagg.prefill.crash` /
+`disagg.handoff.drop` kill the handoff mid-pipeline — all armed through
+the same `FLAGS_fault_inject` registry production uses.
 
 Crash-proof front door (ISSUE 17): with a `journal=` the router writes
 every breaker transition, registry/drain decision, and idempotency
@@ -95,6 +108,16 @@ class NoReadyReplica(RouterError):
 
 
 class RouterOverloaded(RouterError):
+    status = 503
+    retriable = True
+
+
+class NoDecodeCapacity(RouterError):
+    """Disaggregated serving (ISSUE 19): every decode-role worker is
+    page-starved (zero free pages), so the pipeline has nowhere to seat a
+    handoff.  503 + Retry-After — page headroom frees as streams finish,
+    so the shed is retriable by design."""
+
     status = 503
     retriable = True
 
@@ -362,8 +385,14 @@ class Router:
         eligible (every replica loads on demand at admission), it just only
         wins when every resident replica is excluded or breaker-gated.
         Breaker gates are consumed in score order so a half-open trial slot
-        is only spent on the replica actually chosen."""
+        is only spent on the replica actually chosen.
+
+        Page-starved replicas (zero free KV pages) are SKIPPED outright
+        while any alternative exists — not merely down-scored, because a
+        request landed on one parks until a stream finishes — and only
+        reconsidered when they are the whole fleet (ISSUE 19)."""
         cands = []
+        starved = []
         for i, rep in enumerate(self.replicas):
             if rep.rid in exclude:
                 continue
@@ -371,18 +400,79 @@ class Router:
             if s["state"] != "ready" or s["admin_draining"]:
                 continue
             miss = 0 if not adapter else int(adapter not in s["lora_adapters"])
-            cands.append((
+            key = (
                 miss,
                 s["drain_estimate_s"],
                 s["queue_depth"] + s["active_slots"],
                 s["ewma_latency_s"],
                 i,
                 rep,
-            ))
+            )
+            (starved if s["page_free_frac"] <= 0.0 else cands).append(key)
         for *_, rep in sorted(cands, key=lambda c: c[:5]):
             if rep.allow():
                 return rep
+        for *_, rep in sorted(starved, key=lambda c: c[:5]):
+            if rep.allow():
+                return rep
         return None
+
+    def pick_pair(self, exclude_prefill=(), exclude_decode=()):
+        """(prefill, decode) pair for the disaggregated pipeline (ISSUE 19).
+
+        Prefill workers are scored on COMPUTE backlog — drain estimate,
+        queued+active work, EWMA latency — because a prefill hop is one
+        bounded burst of compute.  Decode workers are scored on PAGE
+        headroom first (most free pages wins), then drain estimate: the
+        handoff's cost there is seated residency, not compute.  A decode
+        worker with zero free pages is hard-skipped — never down-scored —
+        and when EVERY decode worker is page-starved the pipeline raises
+        the typed `NoDecodeCapacity` (503 + Retry-After) instead of
+        parking the request.  Either side with no ready replica at all
+        returns None in its slot (the caller falls back to the colocated
+        path).  Breaker gates are consumed in score order, like pick()."""
+        pre_c, dec_c = [], []
+        dec_starved = False
+        for i, rep in enumerate(self.replicas):
+            s = rep.snapshot()
+            if s["state"] != "ready" or s["admin_draining"]:
+                continue
+            role = s.get("role", "colocated")
+            if role == "prefill" and rep.rid not in exclude_prefill:
+                pre_c.append((
+                    s["drain_estimate_s"],
+                    s["queue_depth"] + s["active_slots"],
+                    s["ewma_latency_s"],
+                    i,
+                    rep,
+                ))
+            elif role == "decode" and rep.rid not in exclude_decode:
+                if s["page_free_frac"] <= 0.0:
+                    dec_starved = True
+                    continue
+                dec_c.append((
+                    -s["page_free_frac"],
+                    s["drain_estimate_s"],
+                    s["queue_depth"] + s["active_slots"],
+                    i,
+                    rep,
+                ))
+        pre = next(
+            (r for *_, r in sorted(pre_c, key=lambda c: c[:4]) if r.allow()),
+            None,
+        )
+        dec = next(
+            (r for *_, r in sorted(dec_c, key=lambda c: c[:4]) if r.allow()),
+            None,
+        )
+        if dec is None and dec_starved:
+            _prof.record_disagg_event("no_decode_capacity")
+            _flight.record("disagg", "no decode capacity (all page-starved)")
+            raise NoDecodeCapacity(
+                "every decode worker is page-starved (zero free KV pages)",
+                retry_after_s=self.healthiest_retry_after(),
+            )
+        return pre, dec
 
     def _ready_drains(self):
         return [
@@ -405,9 +495,15 @@ class Router:
         with self._mu:
             inflight = self._inflight
             takeovers = self._takeovers
+        roles = {}
+        for s in snaps:
+            if s["state"] == "ready" and not s["admin_draining"]:
+                role = s.get("role", "colocated")
+                roles[role] = roles.get(role, 0) + 1
         return {
             "status": "ready" if ready else "degraded",
             "ready_replicas": ready,
+            "roles": roles,
             "replicas": snaps,
             "inflight": inflight,
             "breakers": {s["id"]: s["breaker"] for s in snaps},
@@ -536,6 +632,282 @@ class Router:
         return status, body, headers
 
     def _dispatch(self, payload, deadline_t, trace, idem_key=None):
+        if self._disagg_eligible(payload):
+            return self._dispatch_disagg(payload, deadline_t, trace,
+                                         idem_key=idem_key)
+        return self._dispatch_colocated(payload, deadline_t, trace,
+                                        idem_key=idem_key)
+
+    def _disagg_eligible(self, payload):
+        """The disaggregated pipeline engages only when the fleet has BOTH
+        a ready prefill-role and a ready decode-role replica, for
+        single-prompt requests without a LoRA adapter (the prefill worker's
+        exported KV embeds no adapter deltas) — everything else rides the
+        colocated path unchanged."""
+        if not isinstance(payload, dict):
+            return False
+        if payload.get("adapter") or payload.get("handoff"):
+            return False
+        ids = payload.get("input_ids")
+        if not ids or isinstance(ids[0], list):
+            return False
+        has_pre = has_dec = False
+        for rep in self.replicas:
+            s = rep.snapshot()
+            if s["state"] != "ready" or s["admin_draining"]:
+                continue
+            role = s.get("role", "colocated")
+            has_pre = has_pre or role == "prefill"
+            has_dec = has_dec or role == "decode"
+            if has_pre and has_dec:
+                return True
+        return False
+
+    def _dispatch_disagg(self, payload, deadline_t, trace, idem_key=None):
+        """Route one request through the disaggregated pipeline:
+
+            /reserve (decode)  — hold the pages the stream will seat
+            /prefill (prefill) — chunked prefill + 1 token + page export
+            /generate (decode) — import the handoff, stream the rest
+
+        Single-token requests (max_new_tokens <= 1) take the TTFT fast
+        path: /prefill alone, no export, no reservation, no decode hop —
+        the prefill worker's sampled token is the whole response.
+
+        Every hop failure BEFORE the decode response completes is a
+        zero-token failover: no client-visible tokens have crossed, so the
+        whole pipeline retries on a fresh pair (deterministic prefill means
+        the retry's final tokens are bit-identical).  An abandoned
+        reservation is reclaimed by its TTL on the decode side.  Once
+        decode response bytes have crossed, the colocated exactly-once
+        rule applies unchanged (UpstreamIncomplete, never a blind retry)."""
+        from ..fault import injection as _inj
+
+        tid, admit_sid = trace
+        tried_pre, tried_dec = set(), set()
+        attempt = 0
+        while True:
+            remaining = (
+                None if deadline_t is None else deadline_t - time.monotonic()
+            )
+            if remaining is not None and remaining <= 0:
+                _prof.record_router_event("deadline_sheds")
+                return self._error(
+                    504, "DeadlineExhausted",
+                    "deadline spent before the disagg pipeline completed",
+                    False, trace_id=tid,
+                )
+            t_pick = time.perf_counter()
+            try:
+                pre, dec = self.pick_pair(tried_pre, tried_dec)
+                if (pre is None or dec is None) and (tried_pre or tried_dec):
+                    # every distinct pair member was tried; with budget
+                    # left, allow a second pass (a respawn may be back)
+                    tried_pre, tried_dec = set(), set()
+                    pre, dec = self.pick_pair()
+            except NoDecodeCapacity as e:
+                _obs.record(
+                    "disagg.pair", tid, t0=t_pick, t1=time.perf_counter(),
+                    parent_id=admit_sid, attempt=attempt, status="error",
+                    error="NoDecodeCapacity",
+                )
+                return self._error(
+                    e.status, "NoDecodeCapacity", str(e), e.retriable,
+                    self._clamp_retry_after(
+                        self._jitter_retry_after(
+                            e.retry_after_s
+                            if e.retry_after_s is not None
+                            else self.healthiest_retry_after()
+                        ),
+                        deadline_t,
+                    ),
+                    trace_id=tid,
+                )
+            _obs.record(
+                "disagg.pair", tid, t0=t_pick, t1=time.perf_counter(),
+                parent_id=admit_sid, attempt=attempt,
+                prefill=pre.rid if pre is not None else None,
+                decode=dec.rid if dec is not None else None,
+                status="ok" if pre is not None and dec is not None else "error",
+            )
+            if pre is None or dec is None:
+                # one side of the fleet dissolved mid-request: the
+                # colocated path still serves (any role answers /generate)
+                _flight.record(
+                    "disagg", "pair incomplete; colocated fallback",
+                    trace_id=tid,
+                )
+                return self._dispatch_colocated(
+                    payload, deadline_t, trace, idem_key=idem_key
+                )
+            _prof.record_disagg_event("pair_picks")
+            if attempt > 0:
+                _prof.record_router_event("retries")
+                _prof.record_disagg_event("handoff_retries")
+
+            # single-token requests COMPLETE at the prefill hop: the
+            # prefill worker's sampled token IS the whole response, so
+            # no reservation is held and no handoff crosses — probe/TTFT
+            # traffic never queues behind the decode worker's seated
+            # streams (this is the disaggregation TTFT fast path)
+            n_new = int(payload.get("max_new_tokens") or 32)
+            single = n_new <= 1
+            reservation = None
+
+            if not single:
+                # -- hop 1: reserve decode-side pages BEFORE prefill runs --
+                status, body, headers, retriable = self._send(
+                    dec,
+                    {
+                        "prompt_len": len(payload["input_ids"]),
+                        "max_new_tokens": n_new,
+                    },
+                    remaining, trace, attempt=attempt,
+                    path="/reserve", span="disagg.reserve",
+                    partial_retriable=True,
+                )
+                if status != 200:
+                    _prof.record_disagg_event("reserve_fails")
+                    tried_dec.add(dec.rid)
+                    if not retriable or attempt >= self.max_retries:
+                        return status, body, headers
+                    attempt = self._disagg_backoff(attempt, deadline_t)
+                    if attempt is None:
+                        return self._error(
+                            504, "DeadlineExhausted",
+                            "deadline spent during disagg failover", False,
+                            trace_id=tid,
+                        )
+                    continue
+                reservation = body.get("reservation")
+
+            # -- hop 2: prefill + page export on the prefill worker --------
+            status, body, headers, retriable = self._send(
+                pre,
+                {
+                    "input_ids": payload["input_ids"],
+                    "temperature": payload.get("temperature", 0.0),
+                    "eos_token_id": payload.get("eos_token_id"),
+                    "export": not single,
+                },
+                remaining, trace, attempt=attempt,
+                path="/prefill", span="disagg.prefill",
+                partial_retriable=True,
+            )
+            if status != 200:
+                # zero tokens crossed: mid-handoff death (kill -9, crash
+                # drill) is ALWAYS a retriable failover; the reservation
+                # just made is left for its TTL to reclaim
+                tried_pre.add(pre.rid)
+                if not retriable or attempt >= self.max_retries:
+                    return status, body, headers
+                attempt = self._disagg_backoff(attempt, deadline_t)
+                if attempt is None:
+                    return self._error(
+                        504, "DeadlineExhausted",
+                        "deadline spent during disagg failover", False,
+                        trace_id=tid,
+                    )
+                continue
+            if single:
+                # zero-token-to-client until here, so the usual failover
+                # rules applied; now the prefill response IS the result
+                return 200, {
+                    "tokens": list(payload["input_ids"])
+                    + [int(body["first_token"])],
+                }, headers
+            handoff = body.get("handoff")
+
+            # -- handoff: the payload crosses router memory ----------------
+            t_hand = time.perf_counter()
+            try:
+                _inj.inject(
+                    "disagg.handoff.drop", context=f"{pre.rid}->{dec.rid}"
+                )
+            except _inj.InjectedFault as e:
+                # the payload is gone in flight: neither replica failed, so
+                # no breaker/tried bookkeeping — just retry the pipeline
+                # from scratch (deterministic prefill -> identical retry)
+                _obs.record(
+                    "disagg.handoff", tid, t0=t_hand, t1=time.perf_counter(),
+                    parent_id=admit_sid, attempt=attempt, status="error",
+                    error=f"{e}",
+                )
+                _flight.record("disagg", f"handoff dropped: {e}",
+                               trace_id=tid)
+                attempt = self._disagg_backoff(attempt, deadline_t)
+                if attempt is None:
+                    return self._error(
+                        504, "DeadlineExhausted",
+                        "deadline spent during disagg failover", False,
+                        trace_id=tid,
+                    )
+                continue
+            if not isinstance(handoff, dict):
+                tried_pre.add(pre.rid)
+                if attempt >= self.max_retries:
+                    return self._error(
+                        502, "HandoffMissing",
+                        f"prefill worker {pre.rid} answered without a "
+                        "handoff payload", False, trace_id=tid,
+                    )
+                attempt = self._disagg_backoff(attempt, deadline_t)
+                if attempt is None:
+                    return self._error(
+                        504, "DeadlineExhausted",
+                        "deadline spent during disagg failover", False,
+                        trace_id=tid,
+                    )
+                continue
+            _obs.record(
+                "disagg.handoff", tid, t0=t_hand, t1=time.perf_counter(),
+                parent_id=admit_sid, attempt=attempt, status="ok",
+                payload_bytes=handoff.get("payload_bytes"),
+                prefill=pre.rid, decode=dec.rid,
+            )
+
+            # -- hop 3: import + decode on the decode worker ---------------
+            fwd = {
+                k: v for k, v in payload.items()
+                if k not in ("handoff", "reservation")
+            }
+            fwd["handoff"] = handoff
+            fwd["reservation"] = reservation
+            remaining = (
+                None if deadline_t is None else deadline_t - time.monotonic()
+            )
+            status, body, headers, retriable = self._send(
+                dec, fwd, remaining, trace, attempt=attempt,
+                idem_key=idem_key, span="disagg.decode",
+            )
+            if status == 200:
+                return 200, body, headers
+            tried_dec.add(dec.rid)
+            if not retriable or attempt >= self.max_retries:
+                return status, body, headers
+            attempt = self._disagg_backoff(attempt, deadline_t)
+            if attempt is None:
+                return self._error(
+                    504, "DeadlineExhausted",
+                    "deadline spent during disagg failover", False,
+                    trace_id=tid,
+                )
+
+    def _disagg_backoff(self, attempt, deadline_t):
+        """Sleep the jittered backoff (clamped to half the remaining
+        budget) and return the next attempt number — or None when the
+        deadline is already spent, so callers shed instead of sleeping."""
+        delay = self._backoff(attempt)
+        if deadline_t is not None:
+            remaining = deadline_t - time.monotonic()
+            if remaining <= 0.01:
+                _prof.record_router_event("deadline_sheds")
+                return None
+            delay = min(delay, remaining / 2)
+        time.sleep(delay)
+        return attempt + 1
+
+    def _dispatch_colocated(self, payload, deadline_t, trace, idem_key=None):
         tid, admit_sid = trace
         tried = set()
         attempt = 0
@@ -632,29 +1004,47 @@ class Router:
         return self.retry_backoff * (2 ** attempt) * jitter
 
     def _send(self, rep, payload, remaining_s, trace, attempt=0,
-              idem_key=None):
+              idem_key=None, path="/generate", span="replica.forward",
+              partial_retriable=False):
         """One dispatch attempt.  Returns (status, body, headers, retriable)
         and folds the outcome into the replica's breaker/latency state.
 
-        The ``replica.forward`` span id is minted BEFORE the HTTP call so it
-        can ride ``X-Parent-Span`` — the replica's ``serve.handle`` span
-        parents on this attempt, and a dead attempt still leaves an
-        ``aborted`` span joining the failure to the surviving retry."""
+        The forward span id is minted BEFORE the HTTP call so it can ride
+        ``X-Parent-Span`` — the replica's ``serve.handle`` span parents on
+        this attempt, and a dead attempt still leaves an ``aborted`` span
+        joining the failure to the surviving retry.
+
+        `path`/`span` route the disaggregated pipeline's /reserve and
+        /prefill hops through the same breaker + span machinery.
+        `partial_retriable=True` marks a hop that carries NO client-visible
+        tokens: a connection that dies mid-response there is still a
+        zero-token failover, where the /generate hop must fail typed
+        (UpstreamIncomplete) once bytes have crossed."""
         tid, admit_sid = trace
         fwd_sid = _obs.new_span_id()
         t_fwd = time.perf_counter()
         try:
-            status, body, headers, latency = rep.post_generate(
-                payload, remaining_s, trace=(tid, fwd_sid), idem_key=idem_key
-            )
+            # /generate keeps its dedicated entry point — instrumentation
+            # and tests hook post_generate to observe client-visible
+            # dispatches specifically
+            if path == "/generate":
+                status, body, headers, latency = rep.post_generate(
+                    payload, remaining_s, trace=(tid, fwd_sid),
+                    idem_key=idem_key,
+                )
+            else:
+                status, body, headers, latency = rep.post_json(
+                    path, payload, remaining_s, trace=(tid, fwd_sid),
+                    idem_key=idem_key,
+                )
         except ReplicaTransportError as e:
             _obs.record(
-                "replica.forward", tid, t0=t_fwd, t1=time.perf_counter(),
+                span, tid, t0=t_fwd, t1=time.perf_counter(),
                 span_id=fwd_sid, parent_id=admit_sid, status="aborted",
                 replica=rep.rid, attempt=attempt, error=f"{e}",
             )
             rep.record_failure(str(e))
-            if e.response_started:
+            if e.response_started and not partial_retriable:
                 # bytes already reached us: a retry could double-deliver
                 # tokens — fail typed instead (exactly-once)
                 st, bd, hd = self._error(
@@ -669,7 +1059,7 @@ class Router:
             )
             return st, bd, hd, True
         _obs.record(
-            "replica.forward", tid, t0=t_fwd, t1=time.perf_counter(),
+            span, tid, t0=t_fwd, t1=time.perf_counter(),
             span_id=fwd_sid, parent_id=admit_sid,
             status="ok" if status == 200 else "error",
             replica=rep.rid, attempt=attempt, http_status=status,

@@ -45,6 +45,7 @@ _SANITIZED_MODULES = {
     "test_kv_quant",
     "test_tp_serving",
     "test_autoscale_soak",
+    "test_disagg_serving",
 }
 
 

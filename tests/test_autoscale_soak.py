@@ -661,17 +661,27 @@ def test_soak_step_function_chaos(model, tmp_path, monkeypatch):
     against router-managed subprocess replicas, while the autoscaler (the
     REAL `_default_spawn` ReplicaProcess path) scales the fleet 1 -> N and
     back.  Every request resolves exactly once, the organic miss rate
-    holds under the bar, and the flight dump replays every decision."""
+    holds under the bar, and the flight dump replays every decision.
+
+    SOAK_TP > 1 (ci.sh soak sets 2) runs the SAME drill over a
+    TP-sharded fleet: the seed worker and every autoscaler spawn boot
+    with --tp N over the 8 virtual CPU devices, so the control loop's
+    choose_tp device-claim accounting is exercised against real sharded
+    workers (ISSUE 19 satellite)."""
     from paddle_tpu.obs import flight
 
     duration = float(os.environ.get("SOAK_DURATION_S", "600"))
+    tp = int(os.environ.get("SOAK_TP", "1"))
     obs_dir = tmp_path / "flightrec"
     monkeypatch.setenv("PADDLE_OBS_DIR", str(obs_dir))
     flight.reset()
     paddle.set_flags({"FLAGS_fault_hang_sec": 2.0})
     log_dir = str(tmp_path / "logs")
 
-    proc0 = ReplicaProcess(0, _free_port(), log_dir=log_dir).start()
+    extra = ["--tp", str(tp)] if tp > 1 else []
+    proc0 = ReplicaProcess(
+        0, _free_port(), log_dir=log_dir, extra_args=extra,
+    ).start()
     r0 = Replica("r0", proc0.url, process=proc0)
     router = Router([r0], probe_interval=0.2, retry_backoff=0.05)
     asc = None
@@ -688,8 +698,10 @@ def test_soak_step_function_chaos(model, tmp_path, monkeypatch):
             min_replicas=1, max_replicas=3, interval=0.5, up_ticks=2,
             down_ticks=8, up_cooldown=5.0, down_cooldown=20.0,
             up_drain_s=1.0, up_queue_depth=2.0, up_miss_rate=0.05,
-            min_page_free=0.05, down_drain_s=0.5, tp_max=1,
-            devices_total=1, drain_grace=10.0, log_dir=log_dir,
+            min_page_free=0.05, down_drain_s=0.5, tp_max=tp,
+            devices_total=8 if tp > 1 else 1,
+            kv_heads=4 if tp > 1 else None,  # tiny() has 4 KV heads
+            drain_grace=10.0, log_dir=log_dir,
         ).start()
 
         wl = Workload(

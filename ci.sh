@@ -11,7 +11,9 @@
 #   chaos-serve  — the SERVING fault-domain drills (prefill hang -> watchdog
 #                  -> warm restart, NaN isolation, SIGTERM drain, deadline
 #                  eviction), slow HTTP drill included, plus the speculative
-#                  and 4-tenant mixed-adapter reruns, under a hard timeout
+#                  and 4-tenant mixed-adapter reruns and the ISSUE 20
+#                  session repin drill (kill -9 the pinned replica), under
+#                  a hard timeout
 #   chaos-router — the MULTI-REPLICA router drills (ISSUE 9): 2 replicas,
 #                  injected probe flap + kill -9 under Poisson load, breaker
 #                  cycle, rolling drain — exactly-once resolution end to end
@@ -97,6 +99,15 @@ if [ "$MODE" = "chaos-serve" ]; then
   timeout -k 30 600 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
       python -m pytest \
       "tests/test_serving_router.py::test_kill9_chaos_drill_mixed_adapters" \
+      -q -p no:cacheprovider
+  echo "== session repin drill (ISSUE 20) =="
+  # kill -9 the replica holding a session's pinned pages mid-conversation:
+  # the router must break the pin (session_repins counter), fall back to a
+  # stateless re-prefill on the survivor, and answer the next turn with a
+  # 200 bit-identical to a fresh stateless engine — exactly-once preserved
+  timeout -k 30 600 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python -m pytest \
+      "tests/test_sessions.py::test_router_pins_sessions_and_repins_after_death" \
       -q -p no:cacheprovider
   echo "CHAOS-SERVE OK"
   exit 0
@@ -396,6 +407,24 @@ DISAGG_TESTS=(tests/test_disagg_serving.py::test_router_disagg_pipeline_bit_iden
 [ "$MODE" != "fast" ] && DISAGG_TESTS=(tests/test_disagg_serving.py)
 timeout -k 30 600 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python -m pytest "${DISAGG_TESTS[@]}" -q -m "not slow" -p no:cacheprovider
+
+echo "== long-context smoke (ISSUE 20 acceptance subset) =="
+# both tiers, pinned to the 8-device CPU-sim mesh: the cp=2 engine (pages
+# round-robin across shards, online-softmax partials merged via pmax/psum)
+# decodes greedy token-identical to cp=1 with per-shard healthz geometry,
+# a 20-turn session replay stays bit-identical to stateless while skipping
+# >= 90% of its prefill tokens with 0 fresh compiles, and an over-capacity
+# prompt fails typed ContextOverflow at admission; fast mode runs that
+# trio, full mode both files (cp kernel vs gather oracle, q8-under-cp,
+# indivisible-shape fallback, eviction under pressure, warm restart,
+# HTTP 400 capacity body, router session pinning, obs surfaces)
+LONGCTX_TESTS=(tests/test_cp_decode.py::test_cp_engine_greedy_identical_to_cp1_and_healthz
+               tests/test_sessions.py::test_20_turn_session_replay_bit_identical_90pct_saved
+               tests/test_sessions.py::test_context_overflow_typed_at_admission)
+[ "$MODE" != "fast" ] && LONGCTX_TESTS=(tests/test_cp_decode.py tests/test_sessions.py)
+timeout -k 30 600 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest "${LONGCTX_TESTS[@]}" -q -m "not slow" -p no:cacheprovider
 
 echo "== observability smoke (ISSUE 10 acceptance subset) =="
 # both tiers scrape a live replica's /metrics (stable name set, replica

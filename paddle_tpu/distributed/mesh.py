@@ -14,18 +14,19 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXIS_ORDER = ("pp", "dp", "sharding", "sep", "ep", "mp")
+AXIS_ORDER = ("pp", "dp", "sharding", "sep", "ep", "cp", "mp")
 
 _global_mesh = None
 
 
-def build_mesh(dp=1, mp=1, pp=1, sharding=1, sep=1, ep=1, devices=None):
+def build_mesh(dp=1, mp=1, pp=1, sharding=1, sep=1, ep=1, cp=1, devices=None):
     """Create + install the global mesh; degrees must multiply to #devices
     (degree -1 on dp = absorb remaining devices)."""
     global _global_mesh
     devs = list(devices) if devices is not None else list(jax.devices())
     n = len(devs)
-    degrees = {"pp": pp, "dp": dp, "sharding": sharding, "sep": sep, "ep": ep, "mp": mp}
+    degrees = {"pp": pp, "dp": dp, "sharding": sharding, "sep": sep, "ep": ep,
+               "cp": cp, "mp": mp}
     known = 1
     wild = None
     for k, v in degrees.items():
@@ -49,18 +50,23 @@ def build_mesh(dp=1, mp=1, pp=1, sharding=1, sep=1, ep=1, devices=None):
     return _global_mesh
 
 
-def serving_mesh(tp, devices=None):
-    """Build + install an mp-only mesh over the FIRST `tp` devices for
-    tensor-parallel serving.  Passing an explicit device slice (rather than
-    letting leftover devices absorb into 'dp') keeps a TP=4 engine on an
-    8-device host from silently claiming a 2-wide data-parallel axis it
-    never uses."""
+def serving_mesh(tp, cp=1, devices=None):
+    """Build + install a ('cp','mp') serving mesh over the FIRST cp*tp
+    devices: 'mp' (tensor parallel, innermost — ICI-neighbor allreduce per
+    projection) composes with 'cp' (context parallel, ISSUE 20 — one
+    sequence's KV pages block-sharded across the axis, combined once per
+    decode step via the online-softmax partials allreduce).  Passing an
+    explicit device slice (rather than letting leftover devices absorb into
+    'dp') keeps a TP=4 engine on an 8-device host from silently claiming a
+    2-wide data-parallel axis it never uses."""
+    cp = int(cp) if cp else 1
     devs = list(devices) if devices is not None else list(jax.devices())
-    if len(devs) < tp:
+    if len(devs) < tp * cp:
         raise ValueError(
-            f"serving_mesh(tp={tp}) needs {tp} devices, found {len(devs)}"
+            f"serving_mesh(tp={tp}, cp={cp}) needs {tp * cp} devices, "
+            f"found {len(devs)}"
         )
-    return build_mesh(mp=tp, devices=devs[:tp])
+    return build_mesh(mp=tp, cp=cp, devices=devs[: tp * cp])
 
 
 def set_mesh(mesh):

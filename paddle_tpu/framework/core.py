@@ -539,6 +539,28 @@ define_flag(
     "otherwise).  1 disables (single-device engine, no mesh installed)",
 )
 define_flag(
+    "FLAGS_serve_cp", 1,
+    "context-parallel serving (long-context tier): block-shard the paged KV "
+    "arena's PAGE axis across N devices of a 'cp' mesh axis (composing with "
+    "FLAGS_serve_tp as a cp x mp mesh over the first cp*tp devices).  One "
+    "sequence's pages spread round-robin over the shards — sequence page k "
+    "lives on shard k % cp — so a 64k-token prompt's KV never has to fit "
+    "one device's arena; each shard runs the fused paged-decode kernel "
+    "over its local page-table slice and the shards merge per-row online-"
+    "softmax partials (m, l, acc) with one pmax + two psums per step.  "
+    "Requires the paged engine and role=colocated; pool auto-sizing and "
+    "admission headroom become per-shard quantities.  1 disables",
+)
+define_flag(
+    "FLAGS_serve_session_max", 256,
+    "session KV (multi-turn serving): maximum resident sessions per engine. "
+    "A request carrying 'session_id' pins its committed prompt+generation "
+    "pages in the prefix cache so turn N+1 chunk-prefills only the unshared "
+    "suffix; sessions beyond this bound (or under page pressure once the "
+    "unpinned prefix cache is exhausted) are evicted whole, LRU first.  "
+    "Requires the paged engine with the prefix cache enabled",
+)
+define_flag(
     "FLAGS_serve_role", "colocated",
     "disaggregated serving: role this replica plays in the fleet — "
     "'colocated' (classic single-box engine: prefill and decode on the "

@@ -177,7 +177,8 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
     - POST /generate          -> {"tokens": [...]}    (GenerationPredictor or
       ContinuousBatchingEngine; body: {"input_ids": [...] or [[...], ...],
       "max_new_tokens": n, "temperature": t, "eos_token_id": id,
-      "deadline_s": s, "spec_k": k, "adapter": name}).  "spec_k" caps the
+      "deadline_s": s, "spec_k": k, "adapter": name,
+      "session_id": sid}).  "spec_k" caps the
       request's speculative draft length below the engine-wide
       FLAGS_serve_spec_k (0 opts out of speculation; omitted = engine
       default).  "adapter" names a registered LoRA adapter served from the
@@ -187,7 +188,13 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
       replays its cached response byte-identical (marked
       `X-Idempotency-Replay`) within `FLAGS_router_idem_ttl`, an in-flight
       key joins the live generation — at most one generation per key even
-      through connection resets and router failover
+      through connection resets and router failover.  "session_id" (ISSUE
+      20) names a multi-turn KV session on this replica: the engine pins
+      the conversation's committed pages and later turns chunk-prefill
+      only the new suffix.  A prompt past the engine's context is a typed
+      400 (`ContextOverflow`, retriable: false) whose body carries the
+      capacity geometry (`max_len`, and under cp the per-shard page
+      budget) — raised at admission, before any page is touched
     - POST /prefill           -> disaggregated prefill hop (engine-backed,
       ISSUE 19): runs chunked prefill + ONE sampled token, exports the
       committed prompt pages, and answers {"first_token", "prompt_len",
@@ -398,8 +405,23 @@ def serve(path_or_predictor, port=8866, host="127.0.0.1", block=True,
                                 adapter=req.get("adapter"),
                                 handoff=req.get("handoff"),
                                 reservation=req.get("reservation"),
+                                session_id=req.get("session_id"),
                             )
                         )
+                except engine_mod.ContextOverflow as e:
+                    # typed 400, terminal: no replica of this tier holds
+                    # more context — the body carries the capacity geometry
+                    # so the client can right-size or re-route by itself
+                    self._err = type(e).__name__
+                    self._reply(400, {
+                        "error": str(e),
+                        "type": type(e).__name__,
+                        "retriable": False,
+                        "retry_after_s": 0,
+                        "capacity": e.body(),
+                        "trace_id": getattr(self, "_trace_id", None),
+                    })
+                    return
                 except AdapterUnknown as e:
                     # terminal 404: retrying cannot help until someone
                     # registers the adapter — the router must NOT fail over
@@ -744,8 +766,13 @@ def __getattr__(name):
         "ContinuousBatchingEngine", "EngineRequest", "QueueFull",
         "EngineUnavailable", "DeadlineUnattainable", "DeadlineExceeded",
         "RequestCancelled", "EngineRestarted", "NonFiniteLogits",
+        "ContextOverflow",
     ):
         from . import engine as _engine
 
         return getattr(_engine, name)
+    if name == "SessionStore":
+        from .paging import SessionStore
+
+        return SessionStore
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

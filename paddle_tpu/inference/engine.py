@@ -124,6 +124,7 @@ from .paging import (
     PagePool,
     PrefixCache,
     QuantConfigError,
+    SessionStore,
     check_scale_arenas,
     check_table_bounds,
     kv_page_bytes,
@@ -161,6 +162,42 @@ class DeadlineUnattainable(EngineUnavailable):
     """Deadline-aware admission: the request's deadline cannot beat the
     current queue-drain estimate, so admitting it would only burn a slot on
     work guaranteed to be evicted."""
+
+
+class ContextOverflow(ValueError):
+    """Typed 400 (ISSUE 20): the prompt (or prompt + requested generation)
+    cannot fit this engine's context — raised at ADMISSION, before any page
+    is reserved or allocated, so an over-length request costs nothing.
+    Carries the capacity geometry (per-shard under cp) for the HTTP body."""
+
+    def __init__(self, prompt_len, max_len, cp=1, pages_per_shard=0,
+                 page_size=0):
+        self.prompt_len = int(prompt_len)
+        self.max_len = int(max_len)
+        self.cp = int(cp)
+        self.pages_per_shard = int(pages_per_shard)
+        self.page_size = int(page_size)
+        detail = f"prompt length {self.prompt_len} exceeds engine capacity: "
+        detail += f"max_len={self.max_len}"
+        if self.cp > 1:
+            detail += (
+                f" (cp={self.cp} shards x {self.pages_per_shard} pages x "
+                f"{self.page_size} tokens/page per shard)"
+            )
+        super().__init__(detail)
+
+    def body(self):
+        """JSON-safe capacity record for the serving layer's 400 body."""
+        out = {
+            "prompt_len": self.prompt_len,
+            "max_len": self.max_len,
+            "cp": self.cp,
+        }
+        if self.pages_per_shard:
+            out["pages_per_shard"] = self.pages_per_shard
+            out["page_size"] = self.page_size
+            out["tokens_per_shard"] = self.pages_per_shard * self.page_size
+        return out
 
 
 class DeadlineExceeded(TimeoutError):
@@ -252,6 +289,11 @@ class EngineRequest:
         self.kv_export = None
         self.handoff = None
         self.reservation = None
+        # session KV (ISSUE 20): session_id names the multi-turn KV hold
+        # this request rides; session_reused_tokens counts prompt tokens
+        # whose KV came from the session's pinned pages (skipped prefill)
+        self.session_id = None
+        self.session_reused_tokens = 0
         self.ttft_s = None
         self._submit_t = None
         self._deadline_t = None  # absolute perf_counter deadline
@@ -306,7 +348,8 @@ class ContinuousBatchingEngine:
     def __init__(self, model, slots=None, max_len=None, prefill_buckets=None,
                  queue_depth=None, seed=0, paged=None, page_size=None,
                  pool_pages=None, prefix_cache=None, spec_k=None, lora=None,
-                 decode_kernel=None, tp=None, kv_quant=None, role=None):
+                 decode_kernel=None, tp=None, kv_quant=None, role=None,
+                 cp=None, session_max=None):
         import jax
 
         from .. import jit, to_tensor
@@ -345,6 +388,14 @@ class ContinuousBatchingEngine:
 
         self.tp = int(_fcore.flag("FLAGS_serve_tp") if tp is None else tp)
         validate_tp(cfg, self.tp)
+        # context-parallel serving (ISSUE 20): 'cp' composes with 'mp' —
+        # the paged arena's PAGE axis block-shards over cp shards while kv
+        # heads shard over mp.  Validated here, typed errors at
+        # construction; all host-side page bookkeeping becomes per-shard
+        # (PagePool shards, round-robin sequence-page placement).
+        self.cp = int(_fcore.flag("FLAGS_serve_cp") if cp is None else cp)
+        if self.cp < 1:
+            raise ShardingError(f"cp must be >= 1, got {self.cp}")
         self._mesh = None
         if self.tp > 1:
             if int(getattr(cfg, "tensor_parallel_degree", 1)) != self.tp:
@@ -355,18 +406,25 @@ class ContinuousBatchingEngine:
                     f"degree={self.tp}) so its projections are the column/"
                     "row-parallel layers the mesh shards"
                 )
-            from ..models.llama import shard_llama_for_tp
+        if self.tp > 1 or self.cp > 1:
+            self._mesh = _mesh_mod.serving_mesh(self.tp, cp=self.cp)
+            if self.tp > 1:
+                from ..models.llama import shard_llama_for_tp
 
-            self._mesh = _mesh_mod.serving_mesh(self.tp)
-            shard_llama_for_tp(model)
+                shard_llama_for_tp(model)
         # per compiled step at TP>1, GSPMD inserts one allreduce per
         # row-parallel output (o_proj + down_proj per layer) plus one for
         # the vocab-sharded logits' sampling reduction
         _prof.record_mesh_topology(
             devices=len(jax.devices()),
             tp=self.tp,
+            cp=self.cp,
+            # ISSUE 20: cp adds one online-softmax partials combine (pmax +
+            # 2x psum, fused) per layer per decode step on top of the TP
+            # row-parallel allreduces
             allreduce_per_step=(
-                2 * cfg.num_hidden_layers + 1 if self.tp > 1 else 0
+                (2 * cfg.num_hidden_layers + 1 if self.tp > 1 else 0)
+                + (cfg.num_hidden_layers if self.cp > 1 else 0)
             ),
         )
 
@@ -404,6 +462,17 @@ class ContinuousBatchingEngine:
                 f"role={self.role!r} requires the paged engine: the "
                 "prefill->decode handoff rides the page arenas"
             )
+        if self.cp > 1 and not self.paged:
+            raise ShardingError(
+                f"cp={self.cp} requires the paged engine: context "
+                "parallelism shards the page arena, not dense slot buffers"
+            )
+        if self.cp > 1 and self.role != "colocated":
+            raise ShardingError(
+                f"cp={self.cp} with role={self.role!r}: the disaggregated "
+                "handoff assumes single-shard page ownership; run cp on "
+                "colocated replicas"
+            )
         if self.paged:
             ps = int(
                 page_size if page_size is not None
@@ -413,6 +482,12 @@ class ContinuousBatchingEngine:
             # default flag sane for tiny test engines
             self.page_size = max(1, min(ps, self.max_len))
             self.pages_per_seq = -(-self.max_len // self.page_size)
+            if self.cp > 1:
+                # per-shard geometry: sequence page k lives on shard k % cp,
+                # so the table width pads to a cp multiple (shard s's local
+                # table is exactly columns {s, s+cp, ...}) and every shard
+                # holds pages_per_seq/cp entries of a full-length sequence
+                self.pages_per_seq = -(-self.pages_per_seq // self.cp) * self.cp
             # paged-attention kernel selection (ISSUE 13): validated HERE so
             # a forced-fused engine fails at construction, not mid-traffic
             # inside a compiled step
@@ -443,6 +518,14 @@ class ContinuousBatchingEngine:
             )
             if pp <= 0:  # auto: every slot can hold a max_len sequence
                 pp = self.slots * self.pages_per_seq + 1
+                if self.cp > 1:
+                    # PER-SHARD auto-sizing (ISSUE 20): each shard stores
+                    # pages_per_seq/cp pages of every slot's sequence plus
+                    # its own scratch page — the pool total is cp * that,
+                    # the same per-device HBM budget as the cp=1 pool
+                    pp = self.cp * (
+                        self.slots * (self.pages_per_seq // self.cp) + 1
+                    )
                 if self.kv_quant == "int8":
                     # same HBM budget, more pages: the auto pool holds the
                     # BYTES of the full-precision pool, so the int8 arena's
@@ -460,6 +543,11 @@ class ContinuousBatchingEngine:
                         cache_dtype_bytes, "int8",
                     )
                     pp = (self.slots * self.pages_per_seq * full) // q8 + 1
+            if self.cp > 1:
+                # the pool block-shards over cp: equal per-shard ranges,
+                # each with its own scratch page at the range head
+                pp = max(pp, 2 * self.cp)
+                pp = -(-pp // self.cp) * self.cp
             self.pool_pages = int(pp)
             self._caches = None
             self._arenas = [
@@ -468,7 +556,7 @@ class ContinuousBatchingEngine:
                              quant=self.kv_quant)
                 for _ in range(cfg.num_hidden_layers)
             ]
-            if self.tp > 1:
+            if self.tp > 1 or self.cp > 1:
                 for a in self._arenas:
                     shard_kv_for_tp(a)
             # observability (ISSUE 18): arena + scale HBM bytes as set (not
@@ -488,12 +576,22 @@ class ContinuousBatchingEngine:
                 * (page_b - scale_b),
                 scale_bytes=cfg.num_hidden_layers * self.pool_pages * scale_b,
             )
-            self._pool = PagePool(self.pool_pages)
+            self._pool = PagePool(self.pool_pages, shards=self.cp)
             use_prefix = bool(
                 _fcore.flag("FLAGS_serve_prefix_cache")
                 if prefix_cache is None else prefix_cache
             )
             self._prefix = PrefixCache(self.page_size) if use_prefix else None
+            # session KV (ISSUE 20): named multi-turn holds on prefix-cache
+            # chains.  Rides the prefix cache — without it, session_id still
+            # parses but every turn re-prefills statelessly.
+            self._sessions = (
+                SessionStore(capacity=int(
+                    _fcore.flag("FLAGS_serve_session_max")
+                    if session_max is None else session_max
+                ))
+                if self._prefix is not None else None
+            )
             # ignore sub-threshold matches: an accidental few-token overlap
             # between unrelated prompts must not flip a request onto the
             # chunk-prefill path (and its different first-token rounding)
@@ -525,6 +623,7 @@ class ContinuousBatchingEngine:
             self._arenas = None
             self._pool = None
             self._prefix = None
+            self._sessions = None
             self._import_fn = None
             self.decode_kernel = "auto"  # dense engines have no paged path
             self._caches = [
@@ -977,7 +1076,7 @@ class ContinuousBatchingEngine:
     def submit(self, input_ids, max_new_tokens=32, temperature=0.0,
                eos_token_id=None, on_token=None, deadline_s=None,
                trace=None, spec_k=None, adapter=None, export_kv=False,
-               handoff=None, reservation=None):
+               handoff=None, reservation=None, session_id=None):
         """Enqueue one request (1-D token ids).  Returns an EngineRequest
         handle immediately; raises QueueFull when the admission queue is at
         capacity, DeadlineUnattainable when `deadline_s` cannot beat the
@@ -995,7 +1094,10 @@ class ContinuousBatchingEngine:
         is imported through the compiled page scatter instead of
         prefilled, and the payload's first token becomes the request's
         first emitted token.  `reservation` names a reserve_pages() hold
-        this admission consumes."""
+        this admission consumes.  `session_id` (ISSUE 20) names a KV
+        session: the request chunk-prefills only the suffix past the
+        session's pinned pages, and at finish the full committed sequence
+        (prompt + generated) is re-bound so turn N+1 resumes from it."""
         from .. import profiler as _prof
         from .paging import HandoffFormatError, deserialize_kv_handoff
 
@@ -1003,8 +1105,15 @@ class ContinuousBatchingEngine:
         if ids.size == 0:
             raise ValueError("empty prompt")
         if ids.size >= self.max_len:
-            raise ValueError(
-                f"prompt length {ids.size} >= engine max_len {self.max_len}"
+            # typed 400 BEFORE any page is reserved (ISSUE 20): carries the
+            # capacity geometry (per-shard under cp) so the client's error
+            # body says exactly how much context this tier holds
+            raise ContextOverflow(
+                ids.size, self.max_len, cp=self.cp,
+                pages_per_shard=(
+                    (self.pages_per_seq // self.cp) if self.paged else 0
+                ),
+                page_size=self.page_size if self.paged else 0,
             )
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
@@ -1092,11 +1201,15 @@ class ContinuousBatchingEngine:
             # scheduled — fail fast with the same 503 family the queue
             # bound uses instead of parking it forever
             need = self._pages_for(ids.size, max_new_tokens)
-            if need > self._pool.usable_pages:
+            # under cp the binding bound is PER SHARD: sequence page k only
+            # ever comes from shard k % cp, so the worst shard must hold
+            # ceil(need / cp) pages out of its per_shard - 1 usable
+            if -(-need // self.cp) > self._pool.per_shard - 1:
                 raise QueueFull(
                     f"request needs {need} KV pages (prompt {ids.size} + "
                     f"max_new {max_new_tokens} at page size {self.page_size})"
-                    f" but the pool holds {self._pool.usable_pages}",
+                    f" but the pool holds {self._pool.usable_pages}"
+                    + (f" across cp={self.cp} shards" if self.cp > 1 else ""),
                     retry_after_s=self._shed_retry_after(deadline_s),
                 )
         req = EngineRequest(
@@ -1107,6 +1220,18 @@ class ContinuousBatchingEngine:
         req.export_kv = bool(export_kv)
         req.handoff = handoff_state
         req.reservation = None if reservation is None else str(reservation)
+        if session_id is not None:
+            if self._sessions is None:
+                raise ValueError(
+                    "session_id requires a paged engine with a prefix cache "
+                    "(construct with paged=True, prefix_cache=True)"
+                )
+            if handoff_state is not None:
+                raise ValueError(
+                    "session_id cannot combine with a KV handoff import: "
+                    "sessions live on the prefill-owning replica's pages"
+                )
+            req.session_id = str(session_id)
         req._submit_t = time.perf_counter()
         if deadline_s is not None:
             req._deadline_t = req._submit_t + float(deadline_s)
@@ -1371,14 +1496,26 @@ class ContinuousBatchingEngine:
             # handoff reservations — the router's pair-pick reads both
             "role": self.role,
             "reserved_pages": int(self._reserved_pages),
-            # mesh topology (ISSUE 14): degree + axis shape so a fleet
-            # operator can see which replicas are TP-sharded from /healthz
+            # mesh topology (ISSUE 14/20): degrees + axis shape so a fleet
+            # operator can see TP- and CP-sharded replicas from /healthz
             "tp": self.tp,
+            "cp": self.cp,
             "mesh_shape": (
                 {a: int(s) for a, s in self._mesh.shape.items() if int(s) > 1}
                 if self._mesh is not None else {}
             ),
         }
+        if self.cp > 1:
+            # per-shard free pages: the router's long-context scoring needs
+            # the WORST shard (a sequence page can only land on its own
+            # shard), not the flattering pool-wide sum
+            out["page_free_by_shard"] = [
+                int(self._pool.free_count(sh)) for sh in range(self.cp)
+            ]
+        if self._sessions is not None:
+            # session KV residency (ISSUE 20): the router's session
+            # pinning and the paddle_session_* metrics families read these
+            out["sessions"] = self._sessions.stats()
         if self._lora is not None:
             # adapter residency for the router: a replica already holding a
             # request's adapter skips the load stall — least-loaded scoring
@@ -1792,7 +1929,10 @@ class ContinuousBatchingEngine:
         """Headroom available for FRESH allocations when the pages in
         `exclude` (a request's matched prefix pages, about to be mapped by
         incref) must stay resident: they cannot be counted as evictable or
-        the admission check double-counts them.  Caller holds _mu."""
+        the admission check double-counts them.  Session-pinned cache pages
+        (ISSUE 20) still count — the allocator may evict the LRU session to
+        reach them, which is exactly the pressure behavior sessions promise.
+        Caller holds _mu."""
         free = self._pool.free_count()
         if self._prefix is not None:
             free += sum(
@@ -1805,20 +1945,63 @@ class ContinuousBatchingEngine:
         # hold converts into exactly the headroom it promised.
         return free - self._reserved_pages
 
-    def _alloc_page_locked(self):
-        """One fresh page, evicting LRU prefix-cache entries under pressure.
-        Only called after `_page_headroom_locked` covered the request, so the
-        eviction loop terminates with a page.  Caller holds _mu."""
+    def _page_fresh_headroom_by_shard_locked(self, exclude):
+        """Per-cp-shard fresh headroom (ISSUE 20): under context parallelism
+        sequence page k must come from pool shard k % cp, so admission has
+        to cover each shard's demand separately — a pool that is half free
+        on shard 0 cannot serve shard 1's pages.  Same evictability rules as
+        the scalar check.  Caller holds _mu."""
+        free = [self._pool.free_count(sh) for sh in range(self.cp)]
+        if self._prefix is not None:
+            for e in self._prefix.entries():
+                if self._pool.refs[e.page] == 1 and e.page not in exclude:
+                    free[self._pool.shard_of(e.page)] += 1
+        if self._reserved_pages:
+            # reservations are not shard-annotated (disagg roles exclude
+            # cp); cover them conservatively against every shard
+            r = -(-self._reserved_pages // self.cp)
+            free = [f - r for f in free]
+        return free
+
+    def _fresh_need_by_shard(self, start, stop):
+        """How many fresh pages sequence-page indices [start, stop) demand
+        from each cp shard under the round-robin layout (index k -> shard
+        k % cp)."""
+        out = [0] * self.cp
+        for j in range(int(start), int(stop)):
+            out[j % self.cp] += 1
+        return out
+
+    def _alloc_page_locked(self, shard=0):
+        """One fresh page from cp shard `shard`, evicting LRU prefix-cache
+        entries — and, when every evictable entry on the shard is
+        session-pinned, whole LRU sessions (ISSUE 20) — under pressure.
+        Only called after the admission headroom check covered the request,
+        so the eviction loop terminates with a page.  Caller holds _mu."""
         from .. import profiler as _prof
 
-        while self._pool.free_count() == 0:
-            if self._prefix is None or self._prefix.evict_one(self._pool) is None:
-                raise RuntimeError(
-                    "KV page pool exhausted mid-admission — the headroom "
-                    "check should have deferred this request (accounting bug)"
-                )
-            _prof.record_paging_event("cache_evictions")
-        return self._pool.alloc()
+        while self._pool.free_count(shard) == 0:
+            if self._prefix is not None and self._prefix.evict_one(
+                self._pool, shard=shard if self.cp > 1 else None
+            ) is not None:
+                _prof.record_paging_event("cache_evictions")
+                continue
+            if (
+                self._sessions is not None
+                and self._sessions.evict_lru() is not None
+            ):
+                # the evicted session's pins dropped: its chain entries are
+                # now ordinary LRU-evictable cache entries — loop back into
+                # evict_one to actually free a page on this shard
+                _prof.record_paging_event("session_evictions")
+                _prof.record_session_stats(self._sessions.stats())
+                _flight.record("session", "evicted_for_pages", shard=shard)
+                continue
+            raise RuntimeError(
+                "KV page pool exhausted mid-admission — the headroom "
+                "check should have deferred this request (accounting bug)"
+            )
+        return self._pool.alloc(shard)
 
     def _release_slot_pages_locked(self, s):
         """Drop slot `s`'s page mappings (finish/evict/restart): every mapped
@@ -1934,7 +2117,10 @@ class ContinuousBatchingEngine:
                     # from the evictable count — they are about to be pinned.
                     # Handoff imports always land ALL pages fresh (they
                     # commit to the cache after, so future prompts share).
-                    need = self._pages_for(req.prompt.size, req.max_new_tokens)
+                    coverage = self._pages_for(
+                        req.prompt.size, req.max_new_tokens
+                    )
+                    need = coverage
                     exclude = ()
                     if self._prefix is not None and req.handoff is None:
                         m, fulls, tail, _rows = self._prefix.lookup(
@@ -1945,7 +2131,24 @@ class ContinuousBatchingEngine:
                             exclude = set(fulls)
                             if tail is not None:
                                 exclude.add(tail)
-                    if need > self._page_fresh_headroom_locked(exclude):
+                    if self.cp > 1:
+                        # per-shard admission (ISSUE 20): fresh pages land at
+                        # sequence indices [coverage - need, coverage), shard
+                        # k % cp each — every shard must cover its slice
+                        head = self._page_fresh_headroom_by_shard_locked(
+                            exclude
+                        )
+                        by_shard = self._fresh_need_by_shard(
+                            coverage - need, coverage
+                        )
+                        short = any(
+                            n > h for n, h in zip(by_shard, head)
+                        )
+                    else:
+                        short = need > self._page_fresh_headroom_locked(
+                            exclude
+                        )
+                    if short:
                         # page pressure: park the request at the head of the
                         # line (FIFO preserved) until draining slots release
                         # enough pages — submit guaranteed need <= pool, so
@@ -2091,8 +2294,11 @@ class ContinuousBatchingEngine:
                 for p in shared_full:
                     self._pool.incref(p)
                     pages.append(p)
-                for _ in range(len(shared_full), coverage):
-                    pages.append(self._alloc_page_locked())
+                # fresh pages go to their sequence index's cp shard (index
+                # k -> shard k % cp, shards=1 under no cp) — the round-robin
+                # layout the context-parallel decode kernel assumes
+                for i in range(len(shared_full), coverage):
+                    pages.append(self._alloc_page_locked(i % self.cp))
             except RuntimeError:
                 if match_len == 0:
                     raise
@@ -2106,7 +2312,10 @@ class ContinuousBatchingEngine:
                     self._pool.decref(pinned)
                     pinned = None
                 match_len, shared_full, tail_page, tail_rows = 0, [], None, 0
-                pages = [self._alloc_page_locked() for _ in range(coverage)]
+                pages = [
+                    self._alloc_page_locked(i % self.cp)
+                    for i in range(coverage)
+                ]
             copy_args = None
             if match_len and tail_rows > 0:
                 copy_args = (tail_page, pages[len(shared_full)])
@@ -2117,6 +2326,15 @@ class ContinuousBatchingEngine:
                 match_len > 0, tokens_saved=match_len,
                 cow_copies=1 if copy_args else 0,
             )
+            if req.session_id is not None and self._sessions is not None:
+                # session accounting (ISSUE 20): every matched prompt token
+                # is prefill work the session's pinned chain (or the shared
+                # prefix cache) absorbed; bump the session's LRU clock so
+                # an active conversation never evicts under its own turns
+                req.session_reused_tokens = match_len
+                self._sessions.tokens_saved_total += match_len
+                self._sessions.touch(req.session_id)
+                _prof.record_session_stats(self._sessions.stats())
             row_table = self._page_table[s].copy()
         suffix = L - match_len
         bucket = self._bucket_for(suffix)
@@ -2230,7 +2448,9 @@ class ContinuousBatchingEngine:
             self._flush_pending_locked()
             req.max_new_tokens = min(req.max_new_tokens, self.max_len - L)
             coverage = self._pages_for(L, req.max_new_tokens)
-            pages = [self._alloc_page_locked() for _ in range(coverage)]
+            pages = [
+                self._alloc_page_locked(i % self.cp) for i in range(coverage)
+            ]
             self._page_table[s, :] = 0
             self._page_table[s, : len(pages)] = pages
             self._slot_pages[s] = list(pages)
@@ -2693,6 +2913,23 @@ class ContinuousBatchingEngine:
                 logger.exception(
                     "disagg: page export failed for request %d", req.id
                 )
+        if (
+            self.paged and self._sessions is not None
+            and req.session_id is not None and reason in ("eos", "length")
+        ):
+            # session KV (ISSUE 20): commit + pin the FULL committed
+            # sequence (prompt AND generated tokens, truncated to the rows
+            # whose KV actually landed) while the slot still maps its pages
+            # — turn N+1 chunk-prefills only past this point
+            try:
+                self._bind_session_locked(s, req)
+            except Exception:
+                # a failed bind degrades to stateless turn N+1 (re-prefill);
+                # never let it take the finish path down with it
+                logger.exception(
+                    "session: bind failed for request %d (session %r)",
+                    req.id, req.session_id,
+                )
         # recycle immediately: no cache scrub needed — the slot's next
         # prefill overwrites rows [0, bucket) and decode masks the rest
         self._slot_req[s] = None
@@ -2712,6 +2949,42 @@ class ContinuousBatchingEngine:
         self._obs_epoch_close()
         self._dev = None  # membership changed: rebuild device loop state
         self._resolve(req, reason)
+
+    def _bind_session_locked(self, s, req):
+        """Commit slot `s`'s committed rows to the prefix cache and (re)bind
+        the request's session to the covering chain (ISSUE 20).  The
+        committed sequence is concat(prompt, generated)[:pos] — the engine's
+        decode invariant is that KV rows [0, pos) hold exactly those tokens;
+        the LAST emitted token's KV is never written (it would land at row
+        pos on the next step), so it is excluded and turn N+1's chunk
+        prefill recomputes it at its true rope offset.  Caller holds _mu,
+        slot still maps its pages."""
+        from .. import profiler as _prof
+
+        pos = int(self._pos[s])
+        seq = np.concatenate(
+            [req.prompt, np.asarray(req.tokens, np.int32)]
+        )[:pos]
+        if seq.size == 0:
+            return
+        ad = self._req_adapter_id(req)
+        inserted = self._prefix.commit(
+            seq, self._slot_pages[s], self._pool, adapter=ad
+        )
+        if inserted:
+            _prof.record_paging_event("cache_commits", inserted)
+        entries, covered = self._prefix.chain(seq, adapter=ad)
+        evicted = self._sessions.bind(
+            req.session_id, seq, entries, adapter=ad
+        )
+        if evicted:
+            _prof.record_paging_event("session_evictions", len(evicted))
+        _prof.record_session_stats(self._sessions.stats())
+        _flight.record(
+            "session", "bind", req=req.id, sid=req.session_id,
+            tokens=int(seq.size), pages=len(entries), covered=int(covered),
+            turns=self._sessions.get(req.session_id)["turns"],
+        )
 
     def _export_slot_locked(self, s, req):
         """Read slot `s`'s committed prompt rows — [0, L) of every layer's
@@ -2869,7 +3142,8 @@ class ContinuousBatchingEngine:
         # refcount by construction
         check_scale_arenas(self._arenas, pool.num_pages, ps)
         expected = np.zeros(pool.num_pages, np.int64)
-        expected[0] = 1  # scratch pin
+        for p in pool.scratch_pages:
+            expected[p] = 1  # scratch pin (one per cp shard, ISSUE 20)
         for s in range(self.slots):
             row = self._page_table[s]
             mapped = self._slot_pages[s]
@@ -2935,8 +3209,31 @@ class ContinuousBatchingEngine:
                 f"{bad}"
             )
         free = sorted(pool._free)
-        ref0 = [p for p in range(1, pool.num_pages) if pool.refs[p] == 0]
+        ref0 = [
+            p for p in range(pool.num_pages)
+            if pool.refs[p] == 0 and not pool.is_scratch(p)
+        ]
         if free != ref0 or len(set(free)) != len(free):
             raise AssertionError(
                 f"page invariant: free list {free} != ref-0 pages {ref0}"
             )
+        if self.cp > 1:
+            # cp layout invariant: every mapped sequence page sits on the
+            # shard its table column demands (column j -> shard j % cp) —
+            # a misplaced page would silently read as unmapped on device
+            for s in range(self.slots):
+                if self._slot_req[s] is None:
+                    continue
+                row = self._page_table[s]
+                for j in range(row.shape[0]):
+                    p = int(row[j])
+                    if p and pool.shard_of(p) != j % self.cp:
+                        raise AssertionError(
+                            f"page invariant: slot {s} table column {j} "
+                            f"maps page {p} on shard {pool.shard_of(p)}, "
+                            f"expected shard {j % self.cp} (cp={self.cp})"
+                        )
+        if self._sessions is not None:
+            # ISSUE 20 audit clause: session pins reconcile exactly with
+            # live cache entries and their page refcounts
+            self._sessions.check(self._prefix, pool)

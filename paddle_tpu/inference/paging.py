@@ -159,16 +159,25 @@ KV_TP_AXIS = 2
 
 
 def shard_kv_for_tp(cache):
-    """Place a KV cache's k/v buffers on the installed 'mp' mesh, sharded on
-    the kv_heads axis (see KV_TP_AXIS).  No-op without a TP mesh, so the
-    engine calls it unconditionally; returns the cache for chaining."""
+    """Place a KV cache's k/v buffers on the installed serving mesh: kv
+    heads (dim 2) split over 'mp' (see KV_TP_AXIS) and — for paged arenas
+    under context parallelism (ISSUE 20) — the PAGE axis (dim 0) block-split
+    over 'cp', so shard s physically holds pages [s*per_shard,
+    (s+1)*per_shard) and the cp decode kernel streams only local pages.
+    No-op without a mesh, so the engine calls it unconditionally; returns
+    the cache for chaining."""
     from jax.sharding import PartitionSpec as P
 
     from ..distributed import mesh as _mesh
 
-    if _mesh.get_mesh() is None or _mesh.axis_size("mp") <= 1:
+    cp = _mesh.axis_size("cp")
+    if _mesh.get_mesh() is None or (_mesh.axis_size("mp") <= 1 and cp <= 1):
         return cache
-    spec = P(None, None, "mp", None)
+    # dim 0 is pages only for paged arenas (PagedKVCache carries page_size);
+    # a dense slot pool's dim 0 is SLOTS — never cp-sharded
+    page_axis = "cp" if (cp > 1 and hasattr(cache, "page_size")) else None
+    mp_axis = "mp" if _mesh.axis_size("mp") > 1 else None
+    spec = P(page_axis, None, mp_axis, None)
     _mesh.shard_tensor_(cache.k, spec)
     _mesh.shard_tensor_(cache.v, spec)
     # int8 arenas (ISSUE 18): scale buffers share the [pages, page_size,
@@ -340,57 +349,99 @@ def deserialize_kv_handoff(payload, quant, kv_heads, head_dim, n_layers, dtype_n
 
 class PagePool:
     """Refcounted page allocator.  Page 0 is scratch: pinned, never handed
-    out, the target of every redirected garbage write."""
+    out, the target of every redirected garbage write.
 
-    def __init__(self, num_pages):
-        if num_pages < 2:
-            raise ValueError("page pool needs >= 2 pages (1 scratch + 1 usable)")
+    Context parallelism (ISSUE 20) block-shards the arena's page axis over
+    the 'cp' mesh axis, so the pool optionally partitions its id space into
+    `shards` equal contiguous ranges — shard s owns [s*per_shard,
+    (s+1)*per_shard) and its FIRST page (s*per_shard) is that device's local
+    scratch, pinned like page 0.  Sequence page k must be allocated from
+    shard k % cp (the round-robin layout the cp decode kernel assumes), so
+    `alloc` takes the owning shard.  shards=1 is the exact legacy pool."""
+
+    def __init__(self, num_pages, shards=1):
+        shards = int(shards) if shards else 1
+        if shards < 1:
+            raise ValueError(f"page pool shards must be >= 1, got {shards}")
+        if num_pages % shards:
+            raise ValueError(
+                f"page pool size {num_pages} must divide evenly into "
+                f"{shards} shards"
+            )
+        if num_pages < 2 * shards:
+            raise ValueError(
+                "page pool needs >= 2 pages per shard (1 scratch + 1 usable)"
+            )
         self.num_pages = int(num_pages)
+        self.shards = shards
+        self.per_shard = self.num_pages // shards
+        self.scratch_pages = tuple(s * self.per_shard for s in range(shards))
         self.refs = np.zeros(self.num_pages, np.int64)
-        self.refs[0] = 1  # scratch, pinned forever
-        self._free = list(range(1, self.num_pages))
+        for p in self.scratch_pages:
+            self.refs[p] = 1  # scratch, pinned forever
+        self._free_by_shard = [
+            list(range(s * self.per_shard + 1, (s + 1) * self.per_shard))
+            for s in range(shards)
+        ]
+
+    @property
+    def _free(self):
+        """Flat read-only view of every free page id (audits and tests);
+        allocation goes through the per-shard lists."""
+        return [p for lst in self._free_by_shard for p in lst]
 
     @property
     def usable_pages(self):
-        return self.num_pages - 1
+        return self.num_pages - self.shards
 
-    def free_count(self):
-        return len(self._free)
+    def shard_of(self, page):
+        return int(page) // self.per_shard
+
+    def is_scratch(self, page):
+        return int(page) % self.per_shard == 0
+
+    def free_count(self, shard=None):
+        if shard is None:
+            return sum(len(lst) for lst in self._free_by_shard)
+        return len(self._free_by_shard[shard])
 
     def used_count(self):
-        return self.usable_pages - len(self._free)
+        return self.usable_pages - self.free_count()
 
-    def alloc(self):
-        """One page at refcount 1; the caller must have checked free_count
-        (the engine's admission math guarantees it never runs dry)."""
-        if not self._free:
+    def alloc(self, shard=0):
+        """One page at refcount 1 from `shard`'s range; the caller must have
+        checked free_count (the engine's admission math guarantees it never
+        runs dry)."""
+        if not self._free_by_shard[shard]:
             raise RuntimeError(
-                "page pool exhausted — admission reservations should have "
-                "prevented this allocation (accounting bug)"
+                f"page pool shard {shard} exhausted — admission reservations "
+                "should have prevented this allocation (accounting bug)"
             )
-        p = self._free.pop(0)
+        p = self._free_by_shard[shard].pop(0)
         assert self.refs[p] == 0, f"free-list page {p} had refcount {self.refs[p]}"
         self.refs[p] = 1
         return p
 
     def incref(self, page):
-        assert page != 0, "scratch page is never mapped"
+        assert not self.is_scratch(page), "scratch page is never mapped"
         assert self.refs[page] > 0, f"incref on dead page {page}"
         self.refs[page] += 1
 
     def decref(self, page):
-        """Drop one reference; a page hitting 0 returns to the free list."""
-        assert page != 0, "scratch page is never released"
+        """Drop one reference; a page hitting 0 returns to its shard's free
+        list."""
+        assert not self.is_scratch(page), "scratch page is never released"
         assert self.refs[page] > 0, f"decref on dead page {page}"
         self.refs[page] -= 1
         if self.refs[page] == 0:
-            self._free.append(page)
+            self._free_by_shard[self.shard_of(page)].append(page)
             return True
         return False
 
 
 class _Entry:
-    __slots__ = ("key", "parent_key", "page", "rows", "children", "last_used", "tokens")
+    __slots__ = ("key", "parent_key", "page", "rows", "children", "last_used",
+                 "tokens", "pinned")
 
     def __init__(self, key, parent_key, page, rows, tokens):
         self.key = key
@@ -400,6 +451,7 @@ class _Entry:
         self.children = 0
         self.last_used = 0
         self.tokens = tokens  # the page's committed token ids (tuple)
+        self.pinned = 0  # session holds (ISSUE 20); > 0 => never evictable
 
 
 class PrefixCache:
@@ -543,13 +595,24 @@ class PrefixCache:
         if parent is not None:
             parent.children -= 1
 
-    def evict_one(self, pool):
-        """Drop the LRU childless entry and release its page hold.  Returns
-        the evicted entry or None when the cache is empty.  The freed page
-        only reaches the free list if no live slot still maps it — eviction
-        never invalidates a reader."""
+    def evict_one(self, pool, shard=None):
+        """Drop the LRU childless UNPINNED entry and release its page hold.
+        Returns the evicted entry or None when nothing is evictable.  The
+        freed page only reaches the free list if no live slot still maps
+        it — eviction never invalidates a reader.
+
+        Session-pinned entries (entry.pinned > 0, ISSUE 20) are never
+        "childless-evictable": a session's committed chain must survive page
+        pressure until the SESSION is evicted (SessionStore.evict_lru drops
+        the pins first).  Under a sharded pool, `shard` restricts victims to
+        entries whose page lives in that shard's range — evicting elsewhere
+        cannot relieve that shard's pressure."""
         victim = None
         for e in self.entries():
+            if e.pinned > 0:
+                continue  # session hold — the session evicts first
+            if shard is not None and pool.shard_of(e.page) != shard:
+                continue
             if e.rows == self.page_size and (
                 e.children > 0 or self._tails.get(e.key)
             ):
@@ -562,9 +625,198 @@ class PrefixCache:
         pool.decref(victim.page)
         return victim
 
+    def chain(self, tokens, adapter=0):
+        """The committed entry chain covering the longest cached prefix of
+        `tokens` (np.int32 [L]) under `adapter` — full-page links plus an
+        EXACT-match tail.  Unlike `lookup`, coverage may reach all L tokens
+        (it walks what `commit` wrote, not what a new reader could reuse)
+        and the LRU clock is NOT bumped.  Returns (entries, covered_tokens);
+        the SessionStore pins exactly this chain."""
+        ps = self.page_size
+        toks = tokens.tolist() if hasattr(tokens, "tolist") else list(tokens)
+        L = len(toks)
+        key = self._root(adapter)
+        out = []
+        i = 0
+        while i + ps <= L:
+            e = self._full.get((key, tuple(toks[i : i + ps])))
+            if e is None:
+                break
+            out.append(e)
+            key = e.key
+            i += ps
+        covered = i
+        rows = L - i
+        if 0 < rows < ps:
+            for e in self._tails.get(key, ()):
+                if e.tokens == tuple(toks[i:L]):
+                    out.append(e)
+                    covered = L
+                    break
+        return out, covered
+
     def clear(self, pool):
-        """Release every cache hold (engine shutdown / tests)."""
+        """Release every cache hold (engine shutdown / tests).  Session pins
+        are dropped first — callers tearing down the cache tear down the
+        sessions with it (SessionStore holds no page refs of its own)."""
+        for e in self.entries():
+            e.pinned = 0
         n = 0
         while self.evict_one(pool) is not None:
             n += 1
         return n
+
+
+class SessionStore:
+    """First-class multi-turn session KV (ISSUE 20).
+
+    A session is a named, refcounted hold on the PrefixCache chain covering
+    its committed conversation — prompt AND generated tokens of every turn
+    so far.  `bind` walks the chain `PrefixCache.chain` returns for the
+    committed sequence and bumps `entry.pinned` on each link (un-bumping the
+    previous turn's chain), so under page pressure `evict_one` can never
+    reclaim a live session's pages; the pool refcounts themselves stay the
+    cache's — pinning adds no double accounting for the invariant audit to
+    untangle.  Turn N+1's request then chunk-prefills ONLY the unshared
+    suffix through the ordinary prefix-cache admission path, at true rope
+    offsets, with zero new executables.
+
+    Sessions are evicted LRU-whole (a half-pinned chain would be useless),
+    either by capacity at bind time or explicitly by the engine's allocator
+    when the prefix cache alone cannot relieve page pressure.  The store
+    survives warm `restart()`/`fail_all()` for free: it references cache
+    entries, and the warm paths keep pool + prefix cache intact."""
+
+    def __init__(self, capacity=256):
+        self.capacity = max(1, int(capacity))
+        self._sessions = {}  # sid -> record dict
+        self._clock = 0
+        self.tokens_saved_total = 0  # prefill tokens served from pinned KV
+        self.evictions = 0
+        self.binds = 0
+
+    def __len__(self):
+        return len(self._sessions)
+
+    def __contains__(self, sid):
+        return sid in self._sessions
+
+    def sessions(self):
+        return list(self._sessions.values())
+
+    def get(self, sid):
+        return self._sessions.get(sid)
+
+    def tokens(self, sid):
+        s = self._sessions.get(sid)
+        return None if s is None else s["tokens"]
+
+    def touch(self, sid):
+        s = self._sessions.get(sid)
+        if s is not None:
+            self._clock += 1
+            s["last_used"] = self._clock
+        return s
+
+    def bind(self, sid, tokens, entries, adapter=0, tenant=""):
+        """(Re)bind `sid` to the committed sequence `tokens` whose cache
+        chain is `entries`: pin the new chain, then unpin the previous one
+        (in that order, so shared links never transit refcount 0).  Returns
+        the session ids evicted to stay within capacity."""
+        self._clock += 1
+        old = self._sessions.pop(sid, None)
+        for e in entries:
+            e.pinned += 1
+        if old is not None:
+            for e in old["entries"]:
+                e.pinned -= 1
+        self._sessions[sid] = {
+            "sid": sid,
+            "tokens": np.asarray(tokens, np.int32).copy(),
+            "entries": list(entries),
+            "adapter": int(adapter),
+            "tenant": str(tenant or ""),
+            "last_used": self._clock,
+            "turns": (old["turns"] + 1) if old else 1,
+        }
+        self.binds += 1
+        evicted = []
+        while len(self._sessions) > self.capacity:
+            v = self.evict_lru(exclude=sid)
+            if v is None:
+                break
+            evicted.append(v)
+        return evicted
+
+    def release(self, sid):
+        s = self._sessions.pop(sid, None)
+        if s is None:
+            return False
+        for e in s["entries"]:
+            e.pinned -= 1
+        return True
+
+    def evict_lru(self, exclude=None):
+        """Unpin + drop the least-recently-used session (whole — a partially
+        pinned chain serves nobody).  Returns its sid, or None."""
+        victim = None
+        for sid, s in self._sessions.items():
+            if sid == exclude:
+                continue
+            if victim is None or s["last_used"] < victim["last_used"]:
+                victim = s
+        if victim is None:
+            return None
+        self.release(victim["sid"])
+        self.evictions += 1
+        return victim["sid"]
+
+    def clear(self):
+        for sid in list(self._sessions):
+            self.release(sid)
+
+    def pages_pinned(self):
+        """Distinct cache entries (== pages) held by at least one session."""
+        return len({id(e) for s in self._sessions.values() for e in s["entries"]})
+
+    def stats(self):
+        tenants = {s["tenant"] for s in self._sessions.values()}
+        return {
+            "sessions_resident": len(self._sessions),
+            "session_tenants": len(tenants),
+            "session_pages_pinned": self.pages_pinned(),
+            "session_prefill_tokens_saved_total": int(self.tokens_saved_total),
+            "session_evictions_total": int(self.evictions),
+            "session_binds_total": int(self.binds),
+        }
+
+    def check(self, cache, pool):
+        """FLAGS_serve_debug_invariants audit clause (ISSUE 20): every pin
+        on a cache entry is explained by exactly the sessions holding it,
+        every pinned entry is still IN the cache with a live page, and no
+        session references an entry the cache no longer owns.  Raises
+        AssertionError on violation."""
+        want = {}
+        for s in self._sessions.values():
+            for e in s["entries"]:
+                want[id(e)] = want.get(id(e), 0) + 1
+        live = {id(e): e for e in cache.entries()}
+        for s in self._sessions.values():
+            for e in s["entries"]:
+                if id(e) not in live:
+                    raise AssertionError(
+                        f"session invariant: session {s['sid']!r} pins page "
+                        f"{e.page} whose cache entry was removed"
+                    )
+        for e in cache.entries():
+            w = want.get(id(e), 0)
+            if e.pinned != w:
+                raise AssertionError(
+                    f"session invariant: entry page {e.page} pinned="
+                    f"{e.pinned} but {w} session hold(s) reference it"
+                )
+            if e.pinned > 0 and pool.refs[e.page] <= 0:
+                raise AssertionError(
+                    f"session invariant: pinned page {e.page} has refcount "
+                    f"{int(pool.refs[e.page])}"
+                )

@@ -177,10 +177,17 @@ def dump(reason, path=None):
             if lora:
                 header["lora"] = lora
             # mesh topology at death: "was this replica TP-sharded, over
-            # how many devices" anchors any cross-replica comparison
+            # how many devices" anchors any cross-replica comparison (the
+            # 'cp' field says whether decode was context-parallel)
             mesh = _prof.mesh_summary()
             if mesh:
                 header["mesh"] = mesh
+            # session-KV residency at death: "how many conversations were
+            # pinned here, how many pages did they hold" — the state a
+            # router repin drill's stateless fallback is recovering from
+            sess = _prof.session_summary()
+            if sess:
+                header["sessions"] = sess
             # autoscaler state at death: "was the controller acting, how
             # big was the fleet" frames every capacity post-mortem (the
             # per-decision timeline rides the ring as 'autoscale' events)

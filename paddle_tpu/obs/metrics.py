@@ -53,6 +53,11 @@ Metric-name reference (the stable surface the scrape test pins):
     paddle_disagg_no_decode_capacity_total
     paddle_mesh_devices / paddle_mesh_tp_degree
     paddle_mesh_allreduce_per_step
+    paddle_cp_degree / paddle_cp_decode_compiles_total
+    paddle_session_resident / paddle_session_pages_pinned
+    paddle_session_binds_total / paddle_session_evictions_total
+    paddle_session_prefill_tokens_saved_total
+    paddle_session_pin_hits_total / paddle_session_repins_total
     paddle_kv_quant_mode{mode=...} 1
     paddle_kv_quant_arena_bytes / paddle_kv_quant_scale_bytes
     paddle_kv_quant_page_ops_total{op="quantize"|"dequantize"}
@@ -241,6 +246,16 @@ def render(labels=None):
     exp.add("paddle_mesh_allreduce_per_step", g["allreduce_per_step"],
             "static GSPMD allreduces per compiled step (row-parallel "
             "outputs + sampling reduction; 0 at tp=1)", "gauge")
+    exp.add("paddle_cp_degree", g.get("cp", 1),
+            "context-parallel degree of the serving mesh ('cp' axis size; "
+            "pages shard round-robin across it)", "gauge")
+    cp_compiles = sum(
+        v for k, v in snap.get("flash_pallas", {}).items()
+        if k.startswith("paged_decode_fused_cp")
+    )
+    exp.add("paddle_cp_decode_compiles_total", cp_compiles,
+            "context-parallel fused paged-decode kernel compilations "
+            "(shard-local partials + softmax allreduce combine)")
 
     g = snap.get("kv_quant", {})
     exp.add("paddle_kv_quant_mode", 1,
@@ -255,6 +270,20 @@ def render(labels=None):
         exp.add("paddle_kv_quant_page_ops_total", g.get(op, 0),
                 "KV quant-path work: rows quantized on write / mapped pages "
                 "dequantized in-kernel", "counter", {"op": op})
+
+    g = snap.get("sessions", {})
+    exp.add("paddle_session_resident", g.get("sessions_resident", 0),
+            "resident KV sessions (pinned committed-page chains)", "gauge")
+    exp.add("paddle_session_pages_pinned", g.get("session_pages_pinned", 0),
+            "prefix-cache pages pinned by resident sessions", "gauge")
+    exp.add("paddle_session_binds_total", g.get("session_binds_total", 0),
+            "session (re)binds at turn finish")
+    exp.add("paddle_session_evictions_total",
+            g.get("session_evictions_total", 0),
+            "whole-session LRU evictions under page pressure")
+    exp.add("paddle_session_prefill_tokens_saved_total",
+            g.get("session_prefill_tokens_saved_total", 0),
+            "prompt tokens whose prefill was skipped via session KV reuse")
 
     g = snap["router"]
     for key, name in (
@@ -276,6 +305,8 @@ def render(labels=None):
         ("journal_torn_records", "paddle_router_journal_torn_records_total"),
         ("takeovers", "paddle_router_takeovers_total"),
         ("crashes", "paddle_router_crashes_total"),
+        ("session_pin_hits", "paddle_session_pin_hits_total"),
+        ("session_repins", "paddle_session_repins_total"),
     ):
         exp.add(name, g.get(key, 0), f"router events: {key}")
     for rid, state in sorted(g["replica_states"].items()):

@@ -1169,6 +1169,387 @@ def _fused_paged_decode_quant_tp(q, arena_k, arena_v, k_scale, v_scale,
     return fn(q, arena_k, arena_v, k_scale, v_scale, tables, pos)
 
 
+def _fused_paged_decode_partials_forward(q, arena_k, arena_v, tables,
+                                         page_base, pos, max_len, scale,
+                                         interpret=False, k_scale=None,
+                                         v_scale=None):
+    """The fused paged-decode kernel in PARTIALS form, for context-parallel
+    decode (ISSUE 20): identical page-walk, GQA/verify packing, and online-
+    softmax recurrence to `_fused_paged_decode_forward`, with two changes.
+
+    (1) Table columns no longer imply token positions.  Under cp, shard s
+    holds sequence pages {s, s+cp, ...} as LOCAL table columns 0..P_l-1, so
+    the caller passes `page_base` (int32 [P_l], scalar-prefetch): column j's
+    first token position.  The masks become `page_base[j] + lane` where the
+    single-device kernel uses `j*ps + lane` — at cp=1 with
+    page_base[j] = j*ps they are the same arithmetic.
+
+    (2) No `_finish` divide.  The kernel emits its raw online-softmax state
+    — acc [b, hk, qr, d], m [b, hk, qr, 1], l [b, hk, qr, 1], all float32 —
+    so shards can merge exactly:
+
+        m*   = max_s m_s
+        l*   = sum_s l_s * exp(m_s - m*)
+        acc* = sum_s acc_s * exp(m_s - m*)
+        out  = acc* / max(l*, eps)
+
+    which is the SAME two-term merge the kernel itself applies page by page,
+    just reassociated across shards (see `cp_softmax_combine`).  A shard
+    whose every key is masked reports m = -inf, l = 0, acc = 0 and drops out
+    of the sums; the round-robin layout puts sequence page 0 (token 0) on
+    shard 0, so every active row has a finite global m.
+
+    Passing `k_scale`/`v_scale` selects the int8 arena variant: page tiles
+    dequantize in VMEM exactly as in `_fused_paged_decode_quant_forward`."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    quant = k_scale is not None
+    b, sq, h, d = q.shape
+    ps = arena_k.shape[1]
+    hk = arena_k.shape[2]
+    rep = h // hk
+    P = tables.shape[1]
+    R = rep * sq
+    qr = -(-R // 8) * 8  # f32 sublane tile; pad rows are sliced off
+    qt = jnp.transpose(q, (0, 2, 1, 3)).reshape(b, hk, rep, sq, d)
+    qg = qt.reshape(b, hk, R, d)
+    if qr != R:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, qr - R), (0, 0)))
+    pos_v = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (b,))
+    tab = jnp.asarray(tables, jnp.int32).reshape(-1)
+    base = jnp.asarray(page_base, jnp.int32).reshape(-1)
+
+    def kernel(t_ref, base_ref, p_ref, q_ref, k_ref, v_ref, *rest):
+        if quant:
+            ks_ref, vs_ref, oa_ref, om_ref, ol_ref, m_scr, l_scr, acc_scr = rest
+        else:
+            oa_ref, om_ref, ol_ref, m_scr, l_scr, acc_scr = rest
+        j = pl.program_id(2)
+        n_p = pl.num_programs(2)
+        p0 = p_ref[pl.program_id(0)]
+        j0 = base_ref[j]
+
+        @pl.when(j == 0)
+        def _init():
+            m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+            l_scr[...] = jnp.zeros_like(l_scr)
+            acc_scr[...] = jnp.zeros_like(acc_scr)
+
+        # pages entirely beyond the newest visible position (window row
+        # sq-1 sees up to pos + sq - 1) contribute nothing
+        needed = j0 <= p0 + sq - 1
+
+        @pl.when(needed)
+        def _compute():
+            if quant:
+                qb = q_ref[...].astype(jnp.float32)
+                kb = k_ref[...].astype(jnp.float32) * ks_ref[...]
+                vb = v_ref[...].astype(jnp.float32) * vs_ref[...]
+            else:
+                qb = q_ref[...]  # [qr, d]
+                kb = k_ref[...]  # [ps, d] — the page this table entry names
+                vb = v_ref[...]
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [qr, ps]
+            w = jax.lax.broadcasted_iota(jnp.int32, (qr, ps), 0) % sq
+            jid = j0 + jax.lax.broadcasted_iota(jnp.int32, (qr, ps), 1)
+            s = jnp.where((jid <= p0 + w) & (jid < max_len), s, _NEG_INF)
+            m = m_scr[..., 0]
+            l = l_scr[..., 0]
+            m_new = jnp.maximum(m, s.max(-1))
+            p = jnp.exp(s - m_new[..., None])
+            alpha = jnp.exp(m - m_new)
+            m_scr[...] = m_new[..., None]
+            l_scr[...] = (alpha * l + p.sum(-1))[..., None]
+            pv = p if quant else p.astype(vb.dtype)
+            acc_scr[...] = acc_scr[...] * alpha[..., None] + jax.lax.dot_general(
+                pv, vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        @pl.when(j == n_p - 1)
+        def _emit():
+            # partials out, UN-normalized: the cross-shard combine divides.
+            # exp(m) can overflow where m is the -inf init of a fully masked
+            # row; the combine's exp(m - m*) handles that, not us.
+            oa_ref[...] = acc_scr[...]
+            om_ref[...] = m_scr[...]
+            ol_ref[...] = l_scr[...]
+
+    page_tile = pl.BlockSpec(
+        (None, ps, None, d), lambda s, g, j, t, bb, p: (t[s * P + j], 0, g, 0)
+    )
+    scale_tile = pl.BlockSpec(
+        (None, ps, None, 1), lambda s, g, j, t, bb, p: (t[s * P + j], 0, g, 0)
+    )
+    q_tile = pl.BlockSpec(
+        (None, None, qr, d), lambda s, g, j, t, bb, p: (s, g, 0, 0)
+    )
+    ml_tile = pl.BlockSpec(
+        (None, None, qr, 1), lambda s, g, j, t, bb, p: (s, g, 0, 0)
+    )
+    in_specs = [q_tile, page_tile, page_tile]
+    ins = [tab, base, pos_v, qg, arena_k, arena_v]
+    if quant:
+        in_specs += [scale_tile, scale_tile]
+        ins += [k_scale, v_scale]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, hk, P),
+        in_specs=in_specs,
+        out_specs=[q_tile, ml_tile, ml_tile],
+        scratch_shapes=[
+            pltpu.VMEM((qr, 1), jnp.float32),
+            pltpu.VMEM((qr, 1), jnp.float32),
+            pltpu.VMEM((qr, d), jnp.float32),
+        ],
+    )
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hk, qr, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hk, qr, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, hk, qr, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*ins)
+    return acc, m, l
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def _fused_paged_decode_partials(q, arena_k, arena_v, tables, page_base, pos,
+                                 max_len, scale, interpret):
+    """Differentiation-opaque wrapper over the partials kernel — same
+    contract as `_fused_paged_decode` (decode is inference-only)."""
+    return _fused_paged_decode_partials_forward(
+        q, arena_k, arena_v, tables, page_base, pos, max_len, scale,
+        interpret=interpret,
+    )
+
+
+def _fused_paged_decode_partials_fwd(q, arena_k, arena_v, tables, page_base,
+                                     pos, max_len, scale, interpret):
+    out = _fused_paged_decode_partials_forward(
+        q, arena_k, arena_v, tables, page_base, pos, max_len, scale,
+        interpret=interpret,
+    )
+    return out, None
+
+
+def _fused_paged_decode_partials_bwd(max_len, scale, interpret, res, g):
+    raise NotImplementedError(
+        "context-parallel fused paged decode is inference-only (no backward)"
+    )
+
+
+_fused_paged_decode_partials.defvjp(
+    _fused_paged_decode_partials_fwd, _fused_paged_decode_partials_bwd
+)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10))
+def _fused_paged_decode_partials_q8(q, arena_k, arena_v, k_scale, v_scale,
+                                    tables, page_base, pos, max_len, scale,
+                                    interpret):
+    """Quantized partials kernel, differentiation-opaque (see above)."""
+    return _fused_paged_decode_partials_forward(
+        q, arena_k, arena_v, tables, page_base, pos, max_len, scale,
+        interpret=interpret, k_scale=k_scale, v_scale=v_scale,
+    )
+
+
+def _fused_paged_decode_partials_q8_fwd(q, arena_k, arena_v, k_scale, v_scale,
+                                        tables, page_base, pos, max_len,
+                                        scale, interpret):
+    out = _fused_paged_decode_partials_forward(
+        q, arena_k, arena_v, tables, page_base, pos, max_len, scale,
+        interpret=interpret, k_scale=k_scale, v_scale=v_scale,
+    )
+    return out, None
+
+
+def _fused_paged_decode_partials_q8_bwd(max_len, scale, interpret, res, g):
+    raise NotImplementedError(
+        "context-parallel quantized fused paged decode is inference-only"
+    )
+
+
+_fused_paged_decode_partials_q8.defvjp(
+    _fused_paged_decode_partials_q8_fwd, _fused_paged_decode_partials_q8_bwd
+)
+
+
+def cp_softmax_combine(acc, m, l, eps=1e-30):
+    """Merge per-shard online-softmax partials into finished attention.
+
+    Given shard partials acc_s = sum_j e^{s_j - m_s} v_j, m_s = max_j s_j,
+    l_s = sum_j e^{s_j - m_s} over DISJOINT key sets (stacked on a leading
+    shard axis, or pre-reduced by the caller):
+
+        m*   = max_s m_s
+        out  = (sum_s acc_s e^{m_s - m*}) / max(sum_s l_s e^{m_s - m*}, eps)
+
+    — the flash-attention two-term merge reassociated across shards, so the
+    result equals running one online softmax over the union of keys (up to
+    float reassociation).  Fully masked shards (m_s = -inf, l_s = 0) drop
+    out: e^{-inf - m*} = 0 for finite m*; the engine's round-robin page
+    layout guarantees shard 0 sees token 0, keeping m* finite for every
+    active row.  Pure jnp — usable both inside shard_map (after psum/pmax,
+    pass the already-reduced sums with the max) and on stacked arrays in
+    tests."""
+    m_star = jnp.max(m, axis=0)
+    corr = jnp.exp(m - m_star[None])
+    l_star = jnp.sum(l * corr, axis=0)
+    acc_star = jnp.sum(acc * corr, axis=0)
+    return acc_star / jnp.maximum(l_star, eps)
+
+
+def _fused_paged_decode_cp_impl(q, arena_k, arena_v, tables, pos, max_len,
+                                scale, interpret, cp, mp, k_scale=None,
+                                v_scale=None):
+    """Context-parallel dispatch of the fused paged-decode kernel (ISSUE
+    20): `shard_map` over ('cp', 'mp') with the ARENA PAGE axis block-split
+    over 'cp' (shard s physically holds global pages [s*per_shard,
+    (s+1)*per_shard)) and kv heads split over 'mp' exactly as in
+    `_fused_paged_decode_tp`.  q, tables, and pos stay replicated across
+    'cp'.
+
+    Each shard derives its LOCAL view in-jit from the replicated global
+    table: sequence page k lives on shard k % cp (the engine's round-robin
+    allocator invariant), so shard s's columns are k = j*cp + s; a mapped
+    global id g in its range becomes local row g - s*per_shard, anything
+    else (unmapped 0-sentinel columns, other shards' pages never appear)
+    redirects to local row 0 — that shard's own scratch page, whose garbage
+    the position fence masks exactly as on one device.  `page_base[j] =
+    (j*cp + s) * page_size` carries the true token positions into the
+    kernel masks.  The per-shard partials then merge with ONE
+    pmax + two psums over 'cp' (`cp_softmax_combine` math) — the only
+    cross-device traffic the whole decode step adds."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..distributed import mesh as _mesh
+
+    quant = k_scale is not None
+    num_pages = arena_k.shape[0]
+    per_shard = num_pages // cp
+    ps = arena_k.shape[1]
+    b, sq, h, d = q.shape
+    hk = arena_k.shape[2]
+    rep = h // hk
+    R = rep * sq
+
+    mp_ax = "mp" if mp > 1 else None
+    heads = P(None, None, mp_ax, None)
+    pages = P("cp", None, mp_ax, None)
+
+    def body(qq, ak, av, ks, vs, t, p):
+        s = jax.lax.axis_index("cp")
+        Pl = t.shape[1] // cp
+        cols = (s + cp * jnp.arange(Pl, dtype=jnp.int32)).astype(jnp.int32)
+        g = jnp.take(t, cols, axis=1)  # [b, Pl] global page ids
+        loc = g - s * per_shard
+        loc = jnp.where((loc > 0) & (loc < per_shard), loc, 0).astype(jnp.int32)
+        base = (cols * ps).astype(jnp.int32)
+        if quant:
+            acc, m, l = _fused_paged_decode_partials_q8(
+                qq, ak, av, ks, vs, loc, base, p, max_len, scale, interpret
+            )
+        else:
+            acc, m, l = _fused_paged_decode_partials(
+                qq, ak, av, loc, base, p, max_len, scale, interpret
+            )
+        m_star = jax.lax.pmax(m, "cp")
+        corr = jnp.exp(m - m_star)
+        l_star = jax.lax.psum(l * corr, "cp")
+        acc_star = jax.lax.psum(acc * corr, "cp")
+        out = acc_star / jnp.maximum(l_star, 1e-30)  # [b, hk_l, qr, d] f32
+        hk_l = out.shape[1]
+        out = out[:, :, :R].reshape(b, hk_l, rep, sq, d)
+        out = out.reshape(b, hk_l * rep, sq, d).astype(qq.dtype)
+        return jnp.transpose(out, (0, 2, 1, 3))
+
+    if not quant:
+        # dummy replicated scalars keep ONE body signature for both modes
+        k_scale = jnp.zeros((), jnp.float32)
+        v_scale = jnp.zeros((), jnp.float32)
+        scale_spec = P()
+    else:
+        scale_spec = pages
+    fn = shard_map(
+        body,
+        mesh=_mesh.get_mesh(),
+        in_specs=(heads, pages, pages, scale_spec, scale_spec,
+                  P(None, None), P(None)),
+        out_specs=heads,
+        check_rep=False,
+    )
+    return fn(q, arena_k, arena_v, k_scale, v_scale, tables, pos)
+
+
+# custom_vjp opacity, same contract as the single-device fused kernels: the
+# cp combine's pmax/psum have no JAX differentiation rules, and decode is
+# inference-only anyway — dispatch.apply's eager jax.vjp must be able to
+# trace the forward without ever building a backward.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _fused_paged_decode_cp(q, arena_k, arena_v, tables, pos, max_len, scale,
+                           interpret, cp, mp):
+    return _fused_paged_decode_cp_impl(
+        q, arena_k, arena_v, tables, pos, max_len, scale, interpret, cp, mp
+    )
+
+
+def _fused_paged_decode_cp_fwd(q, arena_k, arena_v, tables, pos, max_len,
+                               scale, interpret, cp, mp):
+    return _fused_paged_decode_cp(
+        q, arena_k, arena_v, tables, pos, max_len, scale, interpret, cp, mp
+    ), None
+
+
+def _fused_paged_decode_cp_bwd(max_len, scale, interpret, cp, mp, res, g):
+    raise NotImplementedError(
+        "context-parallel fused paged decode is inference-only"
+    )
+
+
+_fused_paged_decode_cp.defvjp(
+    _fused_paged_decode_cp_fwd, _fused_paged_decode_cp_bwd
+)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
+def _fused_paged_decode_cp_q8(q, arena_k, arena_v, k_scale, v_scale, tables,
+                              pos, max_len, scale, interpret, cp, mp):
+    return _fused_paged_decode_cp_impl(
+        q, arena_k, arena_v, tables, pos, max_len, scale, interpret, cp, mp,
+        k_scale=k_scale, v_scale=v_scale,
+    )
+
+
+def _fused_paged_decode_cp_q8_fwd(q, arena_k, arena_v, k_scale, v_scale,
+                                  tables, pos, max_len, scale, interpret, cp,
+                                  mp):
+    return _fused_paged_decode_cp_q8(
+        q, arena_k, arena_v, k_scale, v_scale, tables, pos, max_len, scale,
+        interpret, cp, mp,
+    ), None
+
+
+def _fused_paged_decode_cp_q8_bwd(max_len, scale, interpret, cp, mp, res, g):
+    raise NotImplementedError(
+        "context-parallel quantized fused paged decode is inference-only"
+    )
+
+
+_fused_paged_decode_cp_q8.defvjp(
+    _fused_paged_decode_cp_q8_fwd, _fused_paged_decode_cp_q8_bwd
+)
+
+
 def paged_decode_attention_array(q, arena_k, arena_v, tables, pos, max_len,
                                  scale=None, kernel="auto", k_scale=None,
                                  v_scale=None):
@@ -1211,13 +1592,30 @@ def paged_decode_attention_array(q, arena_k, arena_v, tables, pos, max_len,
 
         ok, reason = _fused_paged_viable(q, arena_k.shape[1])
         mp = _mesh.axis_size("mp")
+        cp = _mesh.axis_size("cp")
         if ok and mp > 1 and (q.shape[2] % mp or arena_k.shape[2] % mp):
             # engine construction validates this for serving; direct callers
             # (or a q-head count that packs unevenly) fall back to the
             # GSPMD-sharded gather path instead of a shard_map shape error
             ok, reason = False, "paged heads not divisible by mp"
+        if ok and cp > 1 and (tables.shape[1] % cp or arena_k.shape[0] % cp):
+            # the engine pads pages_per_seq and the pool to cp multiples;
+            # direct callers fall back to the GSPMD gather path
+            ok, reason = False, "paged tables/pool not divisible by cp"
         on_path = _on_tpu() or interpret
         if ok and on_path:
+            if cp > 1:
+                _log_pallas_call("paged_decode_fused_cp_q8" if quant else
+                                 "paged_decode_fused_cp")
+                if quant:
+                    return _fused_paged_decode_cp_q8(
+                        q, arena_k, arena_v, k_scale, v_scale, tables, pos,
+                        max_len, scale, interpret, cp, mp,
+                    )
+                return _fused_paged_decode_cp(
+                    q, arena_k, arena_v, tables, pos, max_len, scale,
+                    interpret, cp, mp,
+                )
             _log_pallas_call("paged_decode_fused_q8" if quant else
                              "paged_decode_fused")
             if quant:
@@ -1427,7 +1825,8 @@ def _flash_backward(q, k, v, mask, out, lse, g, causal, scale, block_k=512):
 # their permanent zeros are the proof the gaps are closed.
 _PALLAS_KERNELS = (
     "flash_fwd", "flash_bwd", "decode", "paged_decode_fused",
-    "paged_decode_fused_q8",
+    "paged_decode_fused_q8", "paged_decode_fused_cp",
+    "paged_decode_fused_cp_q8",
 )
 _FALLBACK_REASONS = (
     "attn_mask not key-padding",
@@ -1436,6 +1835,7 @@ _FALLBACK_REASONS = (
     "paged head_dim > 256",
     "paged page_size not 8-aligned",
     "paged heads not divisible by mp",
+    "paged tables/pool not divisible by cp",
     "seq not a 128-multiple",  # retired (pad-and-mask) — must stay 0
     "attn_mask given",         # retired (key-bias lowering) — must stay 0
 )

@@ -300,6 +300,7 @@ def reset():
         _reset_disagg_locked()
         _reset_mesh_locked()
         _reset_kv_quant_locked()
+        _reset_session_locked()
         _flash_fallbacks.clear()
         _flash_pallas.clear()
 
@@ -326,6 +327,7 @@ def metrics_snapshot():
             "disagg": dict(_disagg_gauges),
             "mesh": dict(_mesh_gauges),
             "kv_quant": dict(_kv_quant_gauges),
+            "sessions": dict(_session_gauges),
             "flash_fallbacks": dict(_flash_fallbacks),
             "flash_pallas": dict(_flash_pallas),
         }
@@ -408,23 +410,67 @@ def paging_summary():
 _mesh_gauges = {
     "devices": 0,            # jax devices visible to the process
     "tp": 1,                 # tensor-parallel degree ('mp' axis size)
+    "cp": 1,                 # context-parallel degree ('cp' axis, ISSUE 20)
     "allreduce_per_step": 0, # static GSPMD allreduces per compiled step
 }
 
 
-def record_mesh_topology(devices, tp, allreduce_per_step):
+def record_mesh_topology(devices, tp, allreduce_per_step, cp=1):
     """Record the serving mesh topology (engine construction time)."""
     with _counters_lock:
         g = _mesh_gauges
         g["devices"] = int(devices)
         g["tp"] = int(tp)
+        g["cp"] = int(cp)
         g["allreduce_per_step"] = int(allreduce_per_step)
 
 
 def _reset_mesh_locked():
     _mesh_gauges["devices"] = 0
     _mesh_gauges["tp"] = 1
+    _mesh_gauges["cp"] = 1
     _mesh_gauges["allreduce_per_step"] = 0
+
+
+# session KV gauges (ISSUE 20): the engine pushes its SessionStore's
+# stats() here on every mutation (bind / evict / reuse) so /metrics can
+# render paddle_session_* without reaching into a live engine object
+_session_gauges = {
+    "sessions_resident": 0,
+    "session_tenants": 0,
+    "session_pages_pinned": 0,
+    "session_prefill_tokens_saved_total": 0,
+    "session_evictions_total": 0,
+    "session_binds_total": 0,
+}
+
+
+def record_session_stats(stats):
+    """Fold one SessionStore.stats() dict into the session gauges."""
+    with _counters_lock:
+        for k in _session_gauges:
+            if k in stats:
+                _session_gauges[k] = int(stats[k])
+
+
+def _reset_session_locked():
+    for k in _session_gauges:
+        _session_gauges[k] = 0
+
+
+def reset_sessions():
+    with _counters_lock:
+        _reset_session_locked()
+
+
+def session_summary():
+    """Latest session-KV gauges ({} until a SessionStore has pushed one) —
+    consumed by the flight-recorder dump header."""
+    with _counters_lock:
+        g = dict(_session_gauges)
+    if not any(g.values()):
+        return {}
+    return g
 
 
 def reset_mesh():

@@ -102,6 +102,11 @@ class Replica:
         # both folded from /healthz — pick_pair() routes on these
         self._role = "colocated"
         self._reserved_pages = 0
+        # long-context tier (ISSUE 20): context-parallel degree and resident
+        # session count folded from /healthz — surfaced for observability
+        # and the session drill assertions, not scored on
+        self._cp = 1
+        self._sessions_resident = 0
         self._probes_ok = 0
         self._probes_failed = 0
         # crash-proof front door (ISSUE 17): breaker transitions are
@@ -145,6 +150,8 @@ class Replica:
                 "lora_adapters": self._lora_adapters,
                 "role": self._role,
                 "reserved_pages": self._reserved_pages,
+                "cp": self._cp,
+                "sessions_resident": self._sessions_resident,
                 "probes_ok": self._probes_ok,
                 "probes_failed": self._probes_failed,
             }
@@ -321,6 +328,12 @@ class Replica:
             self._deadline_miss_rate = float(h.get("deadline_miss_rate", 0.0))
             self._role = str(h.get("role", "colocated"))
             self._reserved_pages = int(h.get("reserved_pages", 0))
+            self._cp = int(h.get("cp", 1))
+            sess = h.get("sessions")
+            self._sessions_resident = (
+                int(sess.get("sessions_resident", 0))
+                if isinstance(sess, dict) else 0
+            )
             lora = h.get("lora")
             if isinstance(lora, dict):
                 self._lora_adapters = tuple(lora.get("adapters", ()))

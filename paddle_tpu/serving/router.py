@@ -55,6 +55,15 @@ Routing contract:
   prefill makes the retry's final tokens bit-identical — and abandoned
   reservations expire by TTL on the decode side.
 
+- **Session affinity** (ISSUE 20): requests carrying a ``session_id``
+  pin to the replica holding that session's committed KV pages (the one
+  that last answered a turn for it).  The pin is advisory: a dead,
+  draining, or breaker-open pinned replica is unpinned and the turn falls
+  back to a normal pick — the new replica re-prefills the conversation
+  statelessly, answers bit-identically, and becomes the new pin.
+  Session requests never take the disaggregated pipeline (their KV is
+  replica-resident by construction).
+
 Chaos: `router.replica.hang` wedges one dispatch (bounded by the HTTP
 timeout), `router.replica.flap` fails probes, `router.replica.kill`
 SIGKILLs a managed replica at probe time, `disagg.prefill.crash` /
@@ -89,6 +98,13 @@ from ..obs import metrics as _obs_metrics
 from ..obs import trace as _obs
 from .journal import IdempotencyCache, Journal
 from .replica import Replica, ReplicaTransportError
+
+
+def _count_by_value(mapping):
+    out = {}
+    for v in mapping.values():
+        out[v] = out.get(v, 0) + 1
+    return out
 
 
 class RouterError(RuntimeError):
@@ -176,6 +192,12 @@ class Router:
         self._mu = threading.Lock()
         self._rng = random.Random(seed)  # jitter; accessed under _mu
         self._inflight = 0
+        # session -> replica pinning (ISSUE 20): a session's committed KV
+        # pages live on exactly one replica, so later turns route back to
+        # it.  Advisory, not durable — a dead pin falls back to a normal
+        # pick and the new replica re-prefills statelessly, so the pin map
+        # never needs journaling and exactly-once is untouched.
+        self._session_pins = {}  # sid -> rid; accessed under _mu
         self._stop = threading.Event()
         self._probe_thread = None
         self._crashed = False
@@ -417,6 +439,46 @@ class Router:
                 return rep
         return None
 
+    def _pinned_replica(self, sid, tried):
+        """Resolve a session pin to a usable replica, or None.
+
+        A usable pin is a registered replica that is ready, not draining,
+        not already tried this dispatch, and whose breaker admits traffic.
+        Anything else UNPINS the session (recorded as a repin) and returns
+        None — the caller falls back to a normal pick() and the winning
+        replica re-prefills the conversation statelessly, then becomes the
+        new pin on success."""
+        with self._mu:
+            rid = self._session_pins.get(sid)
+        if rid is None:
+            return None
+        rep = next((r for r in self.replicas if r.rid == rid), None)
+        usable = False
+        if rep is not None and rid not in tried:
+            s = rep.snapshot()
+            usable = (s["state"] == "ready" and not s["admin_draining"]
+                      and rep.allow())
+        if usable:
+            _prof.record_router_event("session_pin_hits")
+            return rep
+        with self._mu:
+            if self._session_pins.get(sid) == rid:
+                del self._session_pins[sid]
+        _prof.record_router_event("session_repins")
+        _flight.record(
+            "session", "pin broken, falling back to stateless re-prefill",
+            session_id=sid, pinned_rid=rid,
+            reason="gone" if rep is None else "unavailable",
+        )
+        return None
+
+    def _pin_session(self, sid, rid):
+        with self._mu:
+            prev = self._session_pins.get(sid)
+            self._session_pins[sid] = rid
+        if prev != rid:
+            _flight.record("session", "pinned", session_id=sid, rid=rid)
+
     def pick_pair(self, exclude_prefill=(), exclude_decode=()):
         """(prefill, decode) pair for the disaggregated pipeline (ISSUE 19).
 
@@ -495,6 +557,7 @@ class Router:
         with self._mu:
             inflight = self._inflight
             takeovers = self._takeovers
+            session_pins = dict(self._session_pins)
         roles = {}
         for s in snaps:
             if s["state"] == "ready" and not s["admin_draining"]:
@@ -510,6 +573,8 @@ class Router:
             "takeovers": takeovers,
             "journal_seq": self.journal.seq if self.journal is not None else None,
             "idempotency": self._idem.stats(),
+            "session_pins": len(session_pins),
+            "session_pins_by_replica": _count_by_value(session_pins),
         }
 
     # -- routing -------------------------------------------------------------
@@ -647,6 +712,10 @@ class Router:
         if not isinstance(payload, dict):
             return False
         if payload.get("adapter") or payload.get("handoff"):
+            return False
+        if payload.get("session_id"):
+            # session KV is replica-resident state; the prefill/decode split
+            # would strand the pinned pages on the wrong worker
             return False
         ids = payload.get("input_ids")
         if not ids or isinstance(ids[0], list):
@@ -946,7 +1015,10 @@ class Router:
                     )
             t_pick = time.perf_counter()
             adapter = payload.get("adapter") if isinstance(payload, dict) else None
-            rep = self.pick(exclude=tried, adapter=adapter)
+            sid = payload.get("session_id") if isinstance(payload, dict) else None
+            rep = self._pinned_replica(sid, tried) if sid else None
+            if rep is None:
+                rep = self.pick(exclude=tried, adapter=adapter)
             if rep is None and tried:
                 # every distinct replica was tried; with budget left, allow
                 # a second pass (a restarted replica may be back)
@@ -978,6 +1050,8 @@ class Router:
                                         attempt=attempt, idem_key=idem_key)
             status, body, headers, retriable = outcome
             if status == 200:
+                if sid:
+                    self._pin_session(sid, rep.rid)
                 return 200, body, headers
             prev_rid = rep.rid
             tried.add(rep.rid)
